@@ -1,0 +1,35 @@
+"""pytorch_distributed_tpu — a TPU-native distributed-training framework.
+
+A brand-new JAX/XLA framework providing the full capability surface of the
+reference teaching repo ``yash-malik/pytorch-distributed`` (see SURVEY.md):
+
+- self-contained GPT-2 (merged QKV, pre-norm, tied head, GPT-2 init) with
+  selective activation checkpointing — as pure functions over a params pytree;
+- kjj0 fineweb10B ``.bin`` data pipeline with deterministic rank-sliced loading;
+- a jitted training loop with gradient accumulation, checkpoint/resume and
+  process-0 logging;
+- data-parallel (DDP-equivalent) and fully-sharded (ZeRO-2/3-equivalent)
+  training expressed as sharding over a `jax.sharding.Mesh` with XLA
+  collectives (psum / all_gather / psum_scatter) instead of NCCL;
+- measurement tooling: analytic + measured memory accounting, fenced
+  throughput benchmarking, scheduled profiler traces, and trace analysis.
+
+Layout:
+  models/    GPT-2 and Llama-style model families (pure init/apply functions)
+  ops/       attention variants (naive, flash/Pallas, ring), remat policies
+  parallel/  mesh helpers, DP/FSDP sharding strategies, collective wrappers
+  data/      .bin shard format, sequential + distributed loaders, synthetic data
+  train/     train state, optimizer, Trainer/DistributedTrainer, checkpointing
+  profiling/ profiler schedule/traces, memory accounting, throughput harness,
+             trace analysis (temporal breakdown, comm/comp overlap, op diff)
+  utils/     config-free helpers: PRNG plumbing, logging, pytree utilities
+"""
+
+__version__ = "0.1.0"
+
+from pytorch_distributed_tpu.config import (  # noqa: F401
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)

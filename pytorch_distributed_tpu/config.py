@@ -1,0 +1,243 @@
+"""Typed configuration for model / data / training / mesh.
+
+The reference has no config system — hyperparameters are hardcoded constants in
+each entry script (reference train_baseline.py:24-31, train_ddp.py:59-64,
+train_fsdp.py:98-103) and model shape comes from HF AutoConfig
+(train_baseline.py:24). We replace that with small frozen dataclasses
+(SURVEY.md §5.6): enough structure to be testable, no Hydra-scale machinery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Transformer architecture config.
+
+    Field names follow GPT-2 conventions (reference model/my_gpt2.py uses the
+    HF GPT2Config fields n_embd/n_head/n_layer/n_ctx, vocab_size,
+    activation_function, layer_norm_epsilon, *_pdrop).
+    """
+
+    # Family: "gpt2" (learned positions, LayerNorm, gelu MLP, tied head) or
+    # "llama" (RoPE, RMSNorm, SwiGLU, untied head) — SURVEY.md §7 stage 8 /
+    # BASELINE.md configs 4-5.
+    family: str = "gpt2"
+
+    vocab_size: int = 50257
+    n_ctx: int = 1024  # max sequence length (positional table size for gpt2)
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    # Defaults to n_head (no GQA); llama-family configs may set fewer KV heads.
+    n_kv_head: int | None = None
+    # MLP hidden size; None → 4*n_embd (gpt2) or the llama 8/3 rule rounded.
+    n_inner: int | None = None
+
+    activation_function: str = "gelu_new"
+    layer_norm_epsilon: float = 1e-5
+    # RoPE base frequency (llama family only).
+    rope_theta: float = 10000.0
+
+    # Dropout probabilities (reference my_gpt2.py:25-26,152 — attn, resid, embd).
+    embd_pdrop: float = 0.1
+    attn_pdrop: float = 0.1
+    resid_pdrop: float = 0.1
+
+    # Numerics: params kept in param_dtype, activations computed in dtype.
+    dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    # Selective activation checkpointing per block (reference my_gpt2.py:145,
+    # 175-183 + pytorch_utils.py:5-17): save compute-intensive matmul outputs,
+    # recompute the rest. One of: "none", "dots" (selective), "full".
+    remat: str = "dots"
+
+    # Attention implementation: "naive" (materialises the T×T score matrix like
+    # reference my_gpt2.py:60-77) or "flash" (blockwise online-softmax /
+    # Pallas). Sequence-parallel ring attention is a parallelism-layer
+    # concern (parallel/), not a per-config switch.
+    attention_impl: str = "naive"
+
+    def __post_init__(self) -> None:
+        if self.n_embd % self.n_head != 0:
+            raise ValueError(
+                f"n_embd={self.n_embd} not divisible by n_head={self.n_head}"
+            )
+        if self.family not in ("gpt2", "llama"):
+            raise ValueError(f"unknown model family: {self.family!r}")
+        # Keep in sync with ops/attention.py dispatch ("ring" joins once
+        # ops/ring_attention.py lands).
+        if self.attention_impl not in ("naive", "flash"):
+            raise ValueError(
+                f"unknown attention_impl: {self.attention_impl!r} "
+                "(implemented: naive, flash)"
+            )
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head if self.n_kv_head is not None else self.n_head
+
+    @property
+    def inner_dim(self) -> int:
+        if self.n_inner is not None:
+            return self.n_inner
+        if self.family == "llama":
+            # Llama FFN rule: 2/3 * 4d, rounded up to a multiple of 256.
+            return ((8 * self.n_embd // 3) + 255) // 256 * 256
+        return 4 * self.n_embd
+
+    def replace(self, **kw: Any) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# Preset shapes. gpt2/gpt2-medium/large/xl match HF AutoConfig presets the
+# reference pulls (train_baseline.py:24 uses "gpt2-large", memory_analysis.py
+# uses "gpt2"). gpt2-1p3b is the BASELINE.md config-3 size (GPT-3 XL shape).
+_GPT2_PRESETS: dict[str, dict[str, int]] = {
+    "gpt2": dict(n_embd=768, n_layer=12, n_head=12),  # 124M
+    "gpt2-medium": dict(n_embd=1024, n_layer=24, n_head=16),  # 355M
+    "gpt2-large": dict(n_embd=1280, n_layer=36, n_head=20),  # 774M
+    "gpt2-xl": dict(n_embd=1600, n_layer=48, n_head=25),  # 1.56B
+    "gpt2-1p3b": dict(n_embd=2048, n_layer=24, n_head=16),  # 1.31B
+}
+
+_LLAMA_PRESETS: dict[str, dict[str, Any]] = {
+    # Llama-3.2-1B / Llama-3.1-8B shapes (BASELINE.md configs 4-5).
+    "llama3-1b": dict(
+        vocab_size=128256, n_ctx=8192, n_embd=2048, n_layer=16, n_head=32,
+        n_kv_head=8, n_inner=8192, rope_theta=500000.0,
+    ),
+    "llama3-8b": dict(
+        vocab_size=128256, n_ctx=8192, n_embd=4096, n_layer=32, n_head=32,
+        n_kv_head=8, n_inner=14336, rope_theta=500000.0,
+    ),
+}
+
+
+def model_config(name: str, **overrides: Any) -> ModelConfig:
+    """Look up a preset by name (the TPU-native analogue of
+    ``AutoConfig.from_pretrained`` in reference train_baseline.py:24)."""
+    if name in _GPT2_PRESETS:
+        base: dict[str, Any] = dict(family="gpt2", **_GPT2_PRESETS[name])
+    elif name in _LLAMA_PRESETS:
+        base = dict(
+            family="llama",
+            activation_function="silu",
+            layer_norm_epsilon=1e-5,
+            embd_pdrop=0.0,
+            attn_pdrop=0.0,
+            resid_pdrop=0.0,
+            **_LLAMA_PRESETS[name],
+        )
+    else:
+        raise KeyError(
+            f"unknown model preset {name!r}; known: "
+            f"{sorted(_GPT2_PRESETS) + sorted(_LLAMA_PRESETS)}"
+        )
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Data pipeline config (reference data/data_loader.py defaults)."""
+
+    data_dir: str = ".cache/data/fineweb10B"
+    batch_size: int = 8  # per-process micro-batch B (reference :83)
+    seq_len: int = 1024  # T (reference :84)
+    num_train_files: int = 10  # reference train_baseline.py:50
+    source: str = "fineweb10B"  # or "synthetic" for tests / zero-egress runs
+    synthetic_tokens: int = 2_000_000
+    seed: int = 42
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training-loop config (reference train_baseline.py:26-31,61-64 and
+    train/trainer.py:9-47)."""
+
+    global_batch_size: int = 32
+    micro_batch_size: int = 8
+    num_steps: int = 20
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    grad_clip_norm: float | None = None
+    # Cosine anneal to min_lr_ratio * learning_rate over num_steps
+    # (reference train_baseline.py:62-64: CosineAnnealingLR eta_min=0.1*lr).
+    lr_schedule: str = "cosine"
+    min_lr_ratio: float = 0.1
+    warmup_steps: int = 0
+
+    seed: int = 42
+    log_every_n_steps: int = 10
+    save_every_n_steps: int | None = None
+    checkpoint_dir: str = "checkpoints"
+
+    def grad_accum_steps(self, data_parallel_size: int = 1) -> int:
+        """Micro-batches per optimizer step. Single-device rule
+        (reference train/trainer.py:31-34) and the distributed rule
+        global // (micro * world) (reference train/distributed_trainer.py:84-88)."""
+        denom = self.micro_batch_size * data_parallel_size
+        if self.global_batch_size % denom != 0:
+            raise ValueError(
+                f"global_batch_size={self.global_batch_size} must be divisible "
+                f"by micro_batch_size*dp={denom}"
+            )
+        return self.global_batch_size // denom
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh / parallelism config (SURVEY.md §2.2, §5.8).
+
+    Axes follow the scaling-book convention: data (DP replicas), fsdp
+    (parameter/grad/opt-state sharding), tensor (TP), seq (sequence/context
+    parallelism for ring attention). Sizes of 1 collapse the axis.
+    """
+
+    data: int = 1
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+
+    # FSDP sharding strategy, mirroring reference train_fsdp.py:49-59:
+    #   "full_shard"     — params+grads+opt sharded (ZeRO-3)
+    #   "shard_grad_op"  — grads+opt sharded, params replicated (ZeRO-2)
+    #   "no_shard"       — DDP-equivalent
+    strategy: str = "full_shard"
+
+    axis_order: tuple[str, ...] = ("data", "fsdp", "seq", "tensor")
+
+    def __post_init__(self) -> None:
+        if self.strategy not in ("full_shard", "shard_grad_op", "no_shard"):
+            raise ValueError(f"unknown FSDP strategy: {self.strategy!r}")
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.fsdp * self.tensor * self.seq
+
+    @property
+    def shape(self) -> dict[str, int]:
+        return {ax: getattr(self, ax) for ax in self.axis_order}
+
+
+@dataclass(frozen=True)
+class ExperimentConfig:
+    """Bundle of everything an entry point needs."""
+
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)

@@ -1,0 +1,13 @@
+from pytorch_distributed_tpu.data.bin_format import (  # noqa: F401
+    HEADER_INTS,
+    MAGIC,
+    VERSION,
+    read_header,
+    read_tokens,
+    write_shard,
+)
+from pytorch_distributed_tpu.data.loader import TokenShardLoader  # noqa: F401
+from pytorch_distributed_tpu.data.distributed_loader import (  # noqa: F401
+    DistributedTokenShardLoader,
+)
+from pytorch_distributed_tpu.data.synthetic import make_synthetic_shards  # noqa: F401

@@ -1,0 +1,82 @@
+"""Deterministic rank-sliced distributed loader.
+
+Behavioral twin of the reference ``DistributedKJJ0DataLoader``
+(reference data/distributed_data_loader.py:9-110, worked example :16-24),
+with the TODO-hinted math completed:
+
+- all processes read the same files in the same order;
+- per batch, process r takes the contiguous chunk
+  ``tokens[pos + r*B*T : pos + (r+1)*B*T + 1]`` (+1 for the target shift)
+  and reshapes it to [B, T];
+- every process then advances ``pos += world*B*T``;
+- shard switch when fewer than ``world*B*T + 1`` tokens remain
+  (so all processes switch in lockstep — deterministic and equivalent to the
+  single-process stream).
+
+TPU-native identity: rank/world default to ``jax.process_index()`` /
+``jax.process_count()`` — the mesh-runtime replacement for torchrun's
+RANK/WORLD_SIZE env vars (reference :44-48) — but can be passed explicitly
+(e.g. one logical slice per mesh data-axis coordinate).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+from pytorch_distributed_tpu.data.loader import TokenShardLoader
+
+
+class DistributedTokenShardLoader(TokenShardLoader):
+    def __init__(
+        self,
+        file_paths,
+        local_batch_size: int,
+        sequence_length: int,
+        *,
+        rank: int | None = None,
+        world_size: int | None = None,
+        mmap: bool = True,
+    ):
+        if rank is None or world_size is None:
+            import jax
+
+            rank = jax.process_index() if rank is None else rank
+            world_size = jax.process_count() if world_size is None else world_size
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+        self.rank = rank
+        self.world_size = world_size
+        self.local_batch_size = local_batch_size
+        super().__init__(
+            file_paths, local_batch_size, sequence_length, mmap=mmap
+        )
+
+    def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        self._reset()
+        b, t = self.local_batch_size, self.sequence_length
+        num_tokens_local = b * t  # reference TODO 2 (:69-70)
+        num_tokens_global = self.world_size * num_tokens_local
+
+        while True:
+            # Lockstep shard switch: need the whole global chunk + 1 to fit
+            # (reference :79-85 condition uses world*B*T), so every process
+            # always finds its full slice — including the last rank's +1
+            # target lookahead — in the current shard.
+            if not self._advance_shard_if_needed(num_tokens_global):
+                return
+
+            # reference TODO 3 (:83-87): this rank's slice start.
+            pos_local = self.current_position + self.rank * num_tokens_local
+            buf = np.asarray(
+                self.current_tokens[pos_local : pos_local + num_tokens_local + 1],
+                dtype=np.int32,
+            )
+            inputs = buf[:-1].reshape(b, t)
+            targets = buf[1:].reshape(b, t)
+
+            # reference TODO 4 (:100-103): all ranks advance together.
+            self.current_position += num_tokens_global
+
+            yield inputs, targets

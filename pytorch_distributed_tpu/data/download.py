@@ -1,0 +1,61 @@
+"""fineweb10B pretokenized shard downloader.
+
+Capability twin of reference data/data_loader.py:9-65
+(``download_fineweb10B_files``): pulls the ``kjj0/fineweb10B-gpt2`` dataset's
+pretokenized shards from the HF Hub into a local cache dir — 1 validation file
+plus up to 103 train files ``fineweb_train_%06d.bin`` — skipping files that
+already exist.
+
+Network access is optional at import time; in zero-egress environments use
+``pytorch_distributed_tpu.data.synthetic`` instead.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+REPO_ID = "kjj0/fineweb10B-gpt2"
+VAL_FILE = "fineweb_val_%06d.bin"
+TRAIN_FILE = "fineweb_train_%06d.bin"
+MAX_TRAIN_FILES = 103
+
+
+def download_fineweb10B_files(
+    data_dir: str | Path = ".cache/data/fineweb10B",
+    num_train_files: int = 10,
+) -> list[str]:
+    """Download val shard + first ``num_train_files`` train shards.
+
+    Returns local train-file paths (sorted). Skips already-present files
+    (reference :28-41,44-62 behavior).
+    """
+    try:
+        from huggingface_hub import hf_hub_download
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "huggingface_hub is unavailable; generate local data with "
+            "pytorch_distributed_tpu.data.synthetic.make_synthetic_shards"
+        ) from e
+
+    num_train_files = min(num_train_files, MAX_TRAIN_FILES)
+    data_dir = Path(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+
+    def fetch(name: str) -> str:
+        local = data_dir / name
+        if local.exists():
+            return str(local)
+        got = hf_hub_download(
+            repo_id=REPO_ID,
+            filename=name,
+            repo_type="dataset",
+            local_dir=str(data_dir),
+        )
+        return str(got)
+
+    fetch(VAL_FILE % 0)
+    train_paths = [
+        fetch(TRAIN_FILE % (i + 1)) for i in range(num_train_files)
+    ]
+    return sorted(train_paths)

@@ -1,0 +1,64 @@
+"""Synthetic pretokenized data in kjj0 shard format.
+
+The reference has no offline data path (its loaders require downloaded
+fineweb10B shards). For zero-egress environments, tests, and benchmarks we
+generate deterministic shards with a seeded PRNG — same binary format, so the
+whole pipeline downstream of download is exercised unmodified.
+
+The token stream is Markov-ish (a mixture of a repeated-ngram process and
+uniform noise) rather than pure uniform, so cross-entropy actually decreases
+during smoke training runs.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import numpy as np
+
+from pytorch_distributed_tpu.data import bin_format
+
+
+def synthetic_token_stream(
+    num_tokens: int, vocab_size: int, seed: int
+) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    # Learnable structure: each token is (prev*2 + 1) mod V with p=0.7,
+    # uniform otherwise.
+    noise = rng.integers(0, vocab_size, size=num_tokens, dtype=np.int64)
+    use_noise = rng.random(num_tokens) > 0.7
+    out = np.empty(num_tokens, dtype=np.int64)
+    prev = int(noise[0])
+    for i in range(num_tokens):
+        if use_noise[i]:
+            prev = int(noise[i])
+        else:
+            prev = (prev * 2 + 1) % vocab_size
+        out[i] = prev
+    return out.astype(np.uint16)
+
+
+def make_synthetic_shards(
+    data_dir: str | Path,
+    *,
+    num_shards: int = 2,
+    tokens_per_shard: int = 100_000,
+    vocab_size: int = 50257,
+    seed: int = 42,
+) -> list[str]:
+    """Write (or reuse) deterministic shards; returns sorted file paths."""
+    if vocab_size > 2**16:
+        raise ValueError("synthetic kjj0 shards require vocab_size <= 65536")
+    data_dir = Path(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    paths = []
+    for i in range(num_shards):
+        path = data_dir / f"synthetic_train_{i:06d}.bin"
+        if not path.exists():
+            tokens = synthetic_token_stream(
+                tokens_per_shard, vocab_size, seed + i
+            )
+            bin_format.write_shard(path, tokens)
+        paths.append(str(path))
+    return sorted(paths)

@@ -1,0 +1,26 @@
+"""Model families: pure ``init(key, cfg) -> params`` / ``apply(params, ids, cfg)``."""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+
+from pytorch_distributed_tpu.config import ModelConfig
+
+
+class ModelApi(NamedTuple):
+    init: Callable[[jax.Array, ModelConfig], dict]
+    apply: Callable[..., jax.Array]
+
+
+def get_model(cfg: ModelConfig) -> ModelApi:
+    if cfg.family == "gpt2":
+        from pytorch_distributed_tpu.models import gpt2
+
+        return ModelApi(gpt2.init, gpt2.apply)
+    if cfg.family == "llama":
+        from pytorch_distributed_tpu.models import llama
+
+        return ModelApi(llama.init, llama.apply)
+    raise KeyError(f"unknown model family {cfg.family!r}")

@@ -1,0 +1,196 @@
+"""GPT-2 as pure functions over a params pytree.
+
+Capability twin of the reference's self-contained model
+(reference model/my_gpt2.py:10-312): merged-QKV attention, pre-norm residual
+blocks, 4x gelu MLP, learned positions, tied LM head, GPT-2 init
+(linear N(0,0.02), wpe N(0,0.01), LN w=1/b=0 — reference :216-244), and
+per-block selective activation checkpointing (reference :145,175-183).
+
+TPU-first design (NOT a translation of the torch class hierarchy):
+- params are a pytree of arrays; block params are **stacked** along a leading
+  n_layer axis and the forward pass is a single ``lax.scan`` over layers —
+  one compiled block body regardless of depth, and stacked [L, ...] leaves
+  shard cleanly under FSDP.
+- dense kernels are [in, out] (MXU-natural; HF Conv1D weights import
+  transpose-free, unlike reference :254-280 which transposes for nn.Linear).
+- remat is ``jax.checkpoint`` around the scanned block with a save-the-dots
+  policy (ops/remat.py) — the analogue of compute_intensive_ops.
+- dropout uses explicit PRNG keys folded per (step, layer).
+
+Params layout (shapes for config E=n_embd, L=n_layer, V=vocab, C=n_ctx,
+F=inner_dim, Q=3E merged qkv):
+  wte [V, E]; wpe [C, E]
+  blocks/ln_1 {scale[L,E], bias[L,E]}     blocks/ln_2 same
+  blocks/attn/c_attn {kernel[L,E,Q], bias[L,Q]}
+  blocks/attn/c_proj {kernel[L,E,E], bias[L,E]}
+  blocks/mlp/c_fc   {kernel[L,E,F], bias[L,F]}
+  blocks/mlp/c_proj {kernel[L,F,E], bias[L,E]}
+  ln_f {scale[E], bias[E]}
+The LM head is weight-tied to wte (reference :206) — no separate leaf.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.config import ModelConfig
+from pytorch_distributed_tpu.ops.attention import multi_head_attention
+from pytorch_distributed_tpu.ops.layers import activation, dense, dropout, layer_norm
+from pytorch_distributed_tpu.ops.remat import apply_remat
+
+Params = dict[str, Any]
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    """GPT-2 initialisation (reference my_gpt2.py:216-244 distributions)."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    e, l, v, c, f = cfg.n_embd, cfg.n_layer, cfg.vocab_size, cfg.n_ctx, cfg.inner_dim
+    q = 3 * e
+
+    keys = jax.random.split(key, 8)
+
+    def normal(k, shape, std):
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * std).astype(pdt)
+
+    def ln(shape):
+        return {"scale": jnp.ones(shape, pdt), "bias": jnp.zeros(shape, pdt)}
+
+    return {
+        "wte": normal(keys[0], (v, e), 0.02),
+        "wpe": normal(keys[1], (c, e), 0.01),
+        "blocks": {
+            "ln_1": ln((l, e)),
+            "attn": {
+                "c_attn": {
+                    "kernel": normal(keys[2], (l, e, q), 0.02),
+                    "bias": jnp.zeros((l, q), pdt),
+                },
+                "c_proj": {
+                    "kernel": normal(keys[3], (l, e, e), 0.02),
+                    "bias": jnp.zeros((l, e), pdt),
+                },
+            },
+            "ln_2": ln((l, e)),
+            "mlp": {
+                "c_fc": {
+                    "kernel": normal(keys[4], (l, e, f), 0.02),
+                    "bias": jnp.zeros((l, f), pdt),
+                },
+                "c_proj": {
+                    "kernel": normal(keys[5], (l, f, e), 0.02),
+                    "bias": jnp.zeros((l, e), pdt),
+                },
+            },
+        },
+        "ln_f": ln((e,)),
+    }
+
+
+def _block(
+    x: jax.Array,
+    bp: Params,
+    cfg: ModelConfig,
+    layer_key: jax.Array | None,
+    deterministic: bool,
+) -> jax.Array:
+    """Pre-norm residual block (reference my_gpt2.py:121-134):
+    x + attn(ln_1(x)); x + mlp(ln_2(x))."""
+    eps = cfg.layer_norm_epsilon
+    b, t, e = x.shape
+    h, d = cfg.n_head, cfg.head_dim
+
+    if layer_key is not None:
+        k_attn, k_resid1, k_mlp = jax.random.split(layer_key, 3)
+    else:
+        k_attn = k_resid1 = k_mlp = None
+
+    # --- attention sub-block (reference my_gpt2.py:38-77, merged QKV :21) ---
+    a = layer_norm(x, bp["ln_1"], eps=eps)
+    qkv = dense(a, bp["attn"]["c_attn"])  # [B, T, 3E]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, t, h, d)
+    k = k.reshape(b, t, h, d)
+    v = v.reshape(b, t, h, d)
+    a = multi_head_attention(
+        q, k, v,
+        impl=cfg.attention_impl,
+        causal=True,
+        dropout_rate=cfg.attn_pdrop,
+        dropout_key=k_attn,
+        deterministic=deterministic,
+    ).reshape(b, t, e)
+    a = dense(a, bp["attn"]["c_proj"])
+    a = dropout(a, cfg.resid_pdrop, k_resid1, deterministic=deterministic)
+    x = x + a
+
+    # --- MLP sub-block (reference my_gpt2.py:80-99) ---
+    m = layer_norm(x, bp["ln_2"], eps=eps)
+    m = dense(m, bp["mlp"]["c_fc"])
+    m = activation(cfg.activation_function)(m)
+    m = dense(m, bp["mlp"]["c_proj"])
+    m = dropout(m, cfg.resid_pdrop, k_mlp, deterministic=deterministic)
+    return x + m
+
+
+def apply(
+    params: Params,
+    input_ids: jax.Array,  # [B, T] int
+    cfg: ModelConfig,
+    *,
+    deterministic: bool = True,
+    dropout_key: jax.Array | None = None,
+    block_transform=None,
+) -> jax.Array:
+    """Forward pass: [B, T] token ids -> [B, T, V] float32 logits.
+
+    Mirrors reference my_gpt2.py:163-188 (trunk) + :211-213 (tied head):
+    wte + wpe -> embd dropout -> n_layer pre-norm blocks -> ln_f -> tied head.
+
+    ``block_transform``, if given, maps each layer's sliced param subtree
+    before use inside the scan — the hook explicit FSDP uses for just-in-time
+    per-layer all_gather (parallel/explicit.py); remat then re-gathers in
+    backward, matching FSDP's free-after-use behavior.
+    """
+    if not deterministic and dropout_key is None:
+        raise ValueError("training-mode apply() requires dropout_key")
+    b, t = input_ids.shape
+    if t > cfg.n_ctx:
+        raise ValueError(f"sequence length {t} exceeds n_ctx {cfg.n_ctx}")
+    dtype = jnp.dtype(cfg.dtype)
+
+    x = params["wte"][input_ids] + params["wpe"][:t]
+    x = x.astype(dtype)
+    if not deterministic:
+        dropout_key, k_embd = jax.random.split(dropout_key)
+        x = dropout(x, cfg.embd_pdrop, k_embd, deterministic=False)
+
+    # Scan over stacked block params; remat each block body. The per-layer
+    # dropout key is folded from (dropout_key, layer_index) inside the scan.
+    def scan_body(carry, xs):
+        bp, layer_idx = xs
+        if block_transform is not None:
+            bp = block_transform(bp)
+        layer_key = (
+            None
+            if deterministic
+            else jax.random.fold_in(dropout_key, layer_idx)
+        )
+        return (
+            _block(carry, bp, cfg, layer_key, deterministic),
+            None,
+        )
+
+    body = apply_remat(scan_body, cfg.remat)
+    layer_ids = jnp.arange(cfg.n_layer)
+    x, _ = jax.lax.scan(body, x, (params["blocks"], layer_ids))
+
+    x = layer_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+    # Tied LM head (reference my_gpt2.py:200-206): logits = x @ wte^T, in f32.
+    logits = jnp.einsum(
+        "bte,ve->btv", x, params["wte"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return logits

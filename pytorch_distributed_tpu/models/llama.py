@@ -1,0 +1,126 @@
+"""Llama-family transformer as pure functions over a params pytree.
+
+Second model family (BASELINE.md configs 4-5: Llama-3 1B/8B FSDP). The
+reference repo only ships GPT-2; this family exists to exercise the framework
+at the benchmark scales with modern architecture: RMSNorm pre-norm, rotary
+positions (no learned table), grouped-query attention, SwiGLU MLP, untied
+LM head, no biases.
+
+Same TPU-first structure as models/gpt2.py: stacked [L, ...] block params,
+one ``lax.scan`` over layers, ``jax.checkpoint`` with a save-dots policy.
+
+Params layout (E=n_embd, L=n_layer, V=vocab, F=inner_dim, H=n_head,
+K=kv_heads, D=head_dim):
+  wte [V, E]
+  blocks/ln_attn {scale[L,E]}        blocks/ln_mlp {scale[L,E]}
+  blocks/attn/{wq [L,E,H*D], wk [L,E,K*D], wv [L,E,K*D], wo [L,H*D,E]}
+  blocks/mlp/{gate [L,E,F], up [L,E,F], down [L,F,E]}
+  ln_f {scale[E]}
+  lm_head [E, V]   (untied)
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.config import ModelConfig
+from pytorch_distributed_tpu.ops.attention import multi_head_attention
+from pytorch_distributed_tpu.ops.layers import rms_norm
+from pytorch_distributed_tpu.ops.remat import apply_remat
+from pytorch_distributed_tpu.ops.rope import apply_rope, rope_angles
+
+Params = dict[str, Any]
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    pdt = jnp.dtype(cfg.param_dtype)
+    e, l, v, f = cfg.n_embd, cfg.n_layer, cfg.vocab_size, cfg.inner_dim
+    h, k, d = cfg.n_head, cfg.kv_heads, cfg.head_dim
+
+    keys = jax.random.split(key, 8)
+
+    def normal(kk, shape, std=0.02):
+        return (jax.random.normal(kk, shape, dtype=jnp.float32) * std).astype(pdt)
+
+    return {
+        "wte": normal(keys[0], (v, e)),
+        "blocks": {
+            "ln_attn": {"scale": jnp.ones((l, e), pdt)},
+            "attn": {
+                "wq": normal(keys[1], (l, e, h * d)),
+                "wk": normal(keys[2], (l, e, k * d)),
+                "wv": normal(keys[3], (l, e, k * d)),
+                "wo": normal(keys[4], (l, h * d, e)),
+            },
+            "ln_mlp": {"scale": jnp.ones((l, e), pdt)},
+            "mlp": {
+                "gate": normal(keys[5], (l, e, f)),
+                "up": normal(keys[6], (l, e, f)),
+                "down": normal(keys[7], (l, f, e)),
+            },
+        },
+        "ln_f": {"scale": jnp.ones((e,), pdt)},
+        "lm_head": normal(jax.random.fold_in(keys[0], 1), (e, v)),
+    }
+
+
+def _block(x, bp, cfg: ModelConfig, cos, sin):
+    eps = cfg.layer_norm_epsilon
+    b, t, e = x.shape
+    h, kv, d = cfg.n_head, cfg.kv_heads, cfg.head_dim
+
+    a = rms_norm(x, bp["ln_attn"], eps=eps)
+    q = (a @ bp["attn"]["wq"].astype(a.dtype)).reshape(b, t, h, d)
+    k = (a @ bp["attn"]["wk"].astype(a.dtype)).reshape(b, t, kv, d)
+    v = (a @ bp["attn"]["wv"].astype(a.dtype)).reshape(b, t, kv, d)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    a = multi_head_attention(
+        q, k, v, impl=cfg.attention_impl, causal=True, deterministic=True
+    ).reshape(b, t, h * d)
+    x = x + a @ bp["attn"]["wo"].astype(a.dtype)
+
+    m = rms_norm(x, bp["ln_mlp"], eps=eps)
+    gate = jax.nn.silu(m @ bp["mlp"]["gate"].astype(m.dtype))
+    up = m @ bp["mlp"]["up"].astype(m.dtype)
+    x = x + (gate * up) @ bp["mlp"]["down"].astype(m.dtype)
+    return x
+
+
+def apply(
+    params: Params,
+    input_ids: jax.Array,
+    cfg: ModelConfig,
+    *,
+    deterministic: bool = True,
+    dropout_key: jax.Array | None = None,
+    block_transform=None,
+) -> jax.Array:
+    """[B, T] int tokens -> [B, T, V] float32 logits. The llama family is
+    dropout-free (cfg presets zero the pdrop fields), so train and eval
+    forward passes coincide. ``block_transform`` — see models/gpt2.py."""
+    del dropout_key, deterministic
+    b, t = input_ids.shape
+    if t > cfg.n_ctx:
+        raise ValueError(f"sequence length {t} exceeds n_ctx {cfg.n_ctx}")
+    dtype = jnp.dtype(cfg.dtype)
+
+    x = params["wte"][input_ids].astype(dtype)
+    cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta)
+
+    def scan_body(carry, bp):
+        if block_transform is not None:
+            bp = block_transform(bp)
+        return _block(carry, bp, cfg, cos, sin), None
+
+    body = apply_remat(scan_body, cfg.remat)
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+
+    x = rms_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+    return jnp.einsum(
+        "bte,ev->btv", x, params["lm_head"].astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )

@@ -1,0 +1,8 @@
+from pytorch_distributed_tpu.ops.attention import multi_head_attention  # noqa: F401
+from pytorch_distributed_tpu.ops.layers import (  # noqa: F401
+    dense,
+    dropout,
+    layer_norm,
+    rms_norm,
+)
+from pytorch_distributed_tpu.ops.remat import apply_remat  # noqa: F401

@@ -1,0 +1,86 @@
+"""Core layer primitives as pure functions over param dicts.
+
+Conventions:
+- Dense kernels are stored ``[in_features, out_features]`` — the natural
+  layout for ``x @ W`` on the MXU. (The torch reference stores nn.Linear
+  weights ``[out, in]`` and has to transpose HF Conv1D weights on import,
+  reference my_gpt2.py:254-280; in this layout HF GPT-2 Conv1D weights import
+  transpose-free.)
+- Normalisation statistics are computed in float32 regardless of the
+  activation dtype, then cast back (bf16-safe).
+- Dropout takes an explicit PRNG key; ``deterministic=True`` or rate 0 is a
+  no-op that traces to nothing under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dense(x: jax.Array, params: dict, *, precision=None) -> jax.Array:
+    """y = x @ kernel + bias. kernel: [in, out]; bias optional."""
+    kernel = params["kernel"].astype(x.dtype)
+    y = jax.lax.dot_general(
+        x, kernel,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        precision=precision,
+    )
+    bias = params.get("bias")
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+def layer_norm(x: jax.Array, params: dict, *, eps: float) -> jax.Array:
+    """LayerNorm with learned scale/bias (reference my_gpt2.py:110-118)."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def rms_norm(x: jax.Array, params: dict, *, eps: float) -> jax.Array:
+    """RMSNorm (llama family)."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    y = y * params["scale"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def dropout(
+    x: jax.Array,
+    rate: float,
+    key: jax.Array | None,
+    *,
+    deterministic: bool,
+) -> jax.Array:
+    if deterministic or rate == 0.0:
+        return x
+    if key is None:
+        raise ValueError("dropout requires a PRNG key when not deterministic")
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, p=keep, shape=x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+_ACTIVATIONS = {
+    # "gelu_new" is HF's tanh-approximated gelu — what ACT2FN resolves to for
+    # GPT-2 (reference my_gpt2.py:90 via transformers.activations).
+    "gelu_new": lambda x: jax.nn.gelu(x, approximate=True),
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+def activation(name: str):
+    try:
+        return _ACTIVATIONS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown activation {name!r}; known: {sorted(_ACTIVATIONS)}"
+        ) from None

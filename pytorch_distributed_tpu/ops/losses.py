@@ -1,0 +1,22 @@
+"""Loss functions.
+
+The reference computes flat next-token cross-entropy over all positions
+(reference train/trainer.py:53-56: F.cross_entropy on [B*T, V] logits vs
+[B*T] targets). Same semantics here, in float32, via log-softmax gather —
+no [B*T, V] one-hot materialisation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean token cross-entropy. logits [..., V] float; targets [...] int."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1
+    ).squeeze(-1)
+    return jnp.mean(logz - gold)

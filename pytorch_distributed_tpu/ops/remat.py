@@ -1,0 +1,43 @@
+"""Selective activation checkpointing policies.
+
+The reference wraps every transformer block in
+``torch.utils.checkpoint.checkpoint`` with a *selective* policy that saves the
+outputs of compute-intensive aten ops (mm/bmm/addmm/SDPA variants — reference
+model/pytorch_utils.py:5-17, my_gpt2.py:145,175-183) and recomputes everything
+else (layernorm/gelu/dropout) in backward.
+
+The TPU-native equivalent is ``jax.checkpoint`` (remat) with
+``checkpoint_dots``: save dot_general results, recompute elementwise ops —
+the same "keep the MXU work, redo the VPU work" trade.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_POLICIES = {
+    # Save nothing: recompute the whole block in backward.
+    "full": None,
+    # Save matmul/attention outputs only — the analogue of the reference's
+    # compute_intensive_ops list.
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    # Save matmuls except those with no batch dims (slightly leaner HBM).
+    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+}
+
+
+def apply_remat(fn, mode: str, *, prevent_cse: bool = False, static_argnums=()):
+    """Wrap ``fn`` in jax.checkpoint according to ``mode``.
+
+    mode: "none" (identity), "full", "dots", "dots_no_batch".
+    prevent_cse=False is safe (and faster) under scan-over-layers.
+    """
+    if mode == "none":
+        return fn
+    if mode not in _POLICIES:
+        raise KeyError(f"unknown remat mode {mode!r}; known: none, {sorted(_POLICIES)}")
+    policy = _POLICIES[mode]
+    kwargs = dict(prevent_cse=prevent_cse, static_argnums=static_argnums)
+    if policy is not None:
+        kwargs["policy"] = policy
+    return jax.checkpoint(fn, **kwargs)

@@ -1,0 +1,44 @@
+"""Rotary position embeddings (llama family).
+
+Half-split convention (matches HF Llama): the head dim is split into two
+halves, rotate_half([x1, x2]) = [-x2, x1], and
+x_rot = x*cos + rotate_half(x)*sin with angles pos / theta^(2i/d).
+Angles are computed in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_angles(
+    seq_len: int, head_dim: int, theta: float, *, offset: int = 0
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (cos, sin), each [seq_len, head_dim] float32."""
+    half = head_dim // 2
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) * 2.0 / head_dim)
+    )
+    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, inv_freq)  # [T, half]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # [T, D]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(
+    x: jax.Array,  # [B, T, H, D]
+    cos: jax.Array,  # [T, D]
+    sin: jax.Array,  # [T, D]
+) -> jax.Array:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return (x32 * c + _rotate_half(x32) * s).astype(dtype)

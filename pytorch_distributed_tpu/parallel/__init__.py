@@ -1,0 +1,12 @@
+from pytorch_distributed_tpu.parallel.mesh import (  # noqa: F401
+    batch_partition_spec,
+    make_mesh,
+    process_info,
+)
+from pytorch_distributed_tpu.parallel.sharding import (  # noqa: F401
+    param_partition_specs,
+    shard_train_state,
+)
+from pytorch_distributed_tpu.parallel.api import (  # noqa: F401
+    make_parallel_train_step,
+)

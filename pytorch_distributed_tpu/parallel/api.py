@@ -1,0 +1,77 @@
+"""Parallel train step: the pjit/NamedSharding ("automatic") path.
+
+The single-device train step (train/trainer.py) is already a pure function;
+making it DDP or FSDP is *only* a matter of sharding annotations — XLA's SPMD
+partitioner inserts the same collectives torch issues imperatively:
+
+  DDP        → gradient all-reduce (reference DDP reducer; here: psum placed
+               at the accumulation boundary because grads of sharded-batch
+               loss feed a replicated weight update)
+  FSDP full  → all_gather(params) before use + reduce_scatter(grads)
+               (reference train_fsdp.py:50-52)
+  FSDP grad_op → reduce_scatter(grads) + sharded update + all_gather(params)
+
+The loss the step returns is already the global mean over the sharded batch —
+the explicit ``dist.all_reduce(loss, AVG)`` of reference
+distributed_trainer.py:131-154 is subsumed by SPMD semantics.
+
+An explicit `shard_map` twin of this path (collectives written by hand, for
+teaching/trace parity) lives in parallel/explicit.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+import optax
+
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
+from pytorch_distributed_tpu.models import ModelApi
+from pytorch_distributed_tpu.parallel.mesh import batch_partition_spec
+from pytorch_distributed_tpu.parallel.sharding import state_shardings
+from pytorch_distributed_tpu.train.state import TrainState
+from pytorch_distributed_tpu.train.trainer import make_train_step
+
+
+def make_parallel_train_step(
+    model: ModelApi,
+    model_cfg: ModelConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    mesh_cfg: MeshConfig,
+    state: TrainState,
+):
+    """Returns (train_step, batch_put) for a sharded TrainState.
+
+    ``train_step`` has the same (state, batch, key) -> (state, metrics)
+    signature as the single-device step; ``batch_put`` places a host
+    [A, B_global, T] batch onto the mesh with the batch sharding (B split
+    over data×fsdp axes, T over seq).
+    """
+    base_step = make_train_step(model, model_cfg, tx, jit=False)
+    shardings = state_shardings(state, mesh, mesh_cfg)
+    batch_sharding = NamedSharding(mesh, batch_partition_spec(mesh_cfg))
+    metrics_sharding = NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+    step = jax.jit(
+        base_step,
+        in_shardings=(
+            shardings,
+            {"inputs": batch_sharding, "targets": batch_sharding},
+            None,
+        ),
+        out_shardings=(shardings, {"loss": metrics_sharding, "grad_norm": metrics_sharding}),
+        donate_argnums=(0,),
+    )
+
+    def batch_put(batch: dict) -> dict:
+        return {
+            k: jax.device_put(np.asarray(v), batch_sharding)
+            for k, v in batch.items()
+        }
+
+    return step, batch_put

@@ -1,0 +1,289 @@
+"""Explicit-collective (shard_map) DP/FSDP — the teaching/trace-parity path.
+
+The pjit path (parallel/api.py) lets XLA place collectives. This module
+writes them BY HAND inside ``shard_map``, so the program text (and the
+profile) shows exactly the communication pattern the reference's torch
+wrappers issue imperatively:
+
+  DDP (no_shard):
+    - each device computes grads on its batch shard, accumulating over
+      micro-batches with NO communication — the ``model.no_sync()`` analogue
+      (reference distributed_trainer.py:115-127) is simply *not psum-ing*;
+    - ONE ``lax.pmean(grads, axes)`` at the accumulation boundary — the
+      bucketed all-reduce of the DDP C++ reducer (reference train_ddp.py:46-49);
+    - ``lax.pmean(loss)`` — the explicit all_reduce(AVG) of
+      reference distributed_trainer.py:131-154.
+
+  FSDP full_shard (ZeRO-3):
+    - params live sharded along "fsdp"; each scanned layer ``all_gather``s
+      its block params just-in-time (reference: per-wrapped-module gather,
+      train_fsdp.py:50-52,71-81);
+    - the backward of that gather IS reduce-scatter: AD transposes
+      ``all_gather`` to ``psum_scatter``, so gradient reduce-scatter appears
+      without being written;
+    - remat of the scanned block re-gathers in backward, matching FSDP's
+      free-after-use + re-gather-in-backward behavior;
+    - optimizer update runs on the local shard only.
+
+  FSDP shard_grad_op (ZeRO-2):
+    - params replicated in compute (no forward gather);
+    - grads ``psum_scatter``-ed along "fsdp" (+ pmean over "data");
+    - sharded Adam update, then ``all_gather`` of updated param shards —
+      reduce_scatter + sharded-update + all_gather ≡ one all-reduce's
+      bandwidth, with 1/N optimizer memory (reference train_fsdp.py:52-53).
+
+Numerical contract: identical results to the single-device step and the pjit
+path (tested in tests/test_parallel.py) — psum ordering and mean-vs-sum
+conventions are pinned by those tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # stable location since jax 0.6
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
+from pytorch_distributed_tpu.models import ModelApi
+from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.ops.remat import apply_remat
+from pytorch_distributed_tpu.parallel.mesh import batch_partition_spec
+from pytorch_distributed_tpu.parallel.sharding import param_partition_specs
+from pytorch_distributed_tpu.train.state import TrainState
+
+
+def _dp_axes(mesh_cfg: MeshConfig) -> tuple[str, ...]:
+    """Axes the batch is split over (grad-reduction axes)."""
+    return tuple(ax for ax in ("data", "fsdp") if getattr(mesh_cfg, ax) > 1)
+
+
+def _sharded_dim(spec: P) -> int | None:
+    for i, ax in enumerate(spec):
+        if ax is not None:
+            return i
+    return None
+
+
+def _gather_params(params, specs):
+    """all_gather each sharded leaf along its sharded dim (tiled)."""
+
+    def gather(leaf, spec):
+        dim = _sharded_dim(spec)
+        if dim is None:
+            return leaf
+        return jax.lax.all_gather(leaf, "fsdp", axis=dim, tiled=True)
+
+    return jax.tree.map(gather, params, specs)
+
+
+def _scatter_grads(grads, specs, fsdp_size: int):
+    """psum_scatter each leaf along its sharded dim; replicated leaves get a
+    plain psum. Produces the *sum* over the fsdp axis."""
+
+    def scatter(leaf, spec):
+        dim = _sharded_dim(spec)
+        if dim is None:
+            return jax.lax.psum(leaf, "fsdp")
+        return jax.lax.psum_scatter(
+            leaf, "fsdp", scatter_dimension=dim, tiled=True
+        )
+
+    return jax.tree.map(scatter, grads, specs)
+
+
+def make_explicit_train_step(
+    model: ModelApi,
+    model_cfg: ModelConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    mesh_cfg: MeshConfig,
+    state: TrainState,
+) -> Callable:
+    """Build a jitted explicit-collective (state, batch, key) -> (state,
+    metrics) step. State must already be placed per
+    parallel.sharding.shard_train_state (same shardings as the pjit path)."""
+    if mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
+        raise NotImplementedError(
+            "explicit path covers data/fsdp axes; tensor/seq use the pjit path"
+        )
+    strategy = mesh_cfg.strategy
+    fsdp_size = mesh_cfg.fsdp
+    dp_axes = _dp_axes(mesh_cfg)
+    p_specs = param_partition_specs(state.params, mesh_cfg)
+    from pytorch_distributed_tpu.parallel.sharding import (
+        opt_state_partition_specs,
+    )
+
+    o_specs = opt_state_partition_specs(state.opt_state, p_specs, mesh_cfg)
+    # ZeRO-2 shards grads/opt-state in the layout params WOULD have under
+    # full_shard, even though params stay replicated.
+    shard_specs = param_partition_specs(
+        state.params, dataclasses.replace(mesh_cfg, strategy="full_shard")
+    )
+    batch_spec = batch_partition_spec(mesh_cfg)
+    train_mode = (
+        model_cfg.embd_pdrop > 0
+        or model_cfg.attn_pdrop > 0
+        or model_cfg.resid_pdrop > 0
+    )
+
+    # Per-layer specs for stacked block leaves: drop the (never-sharded)
+    # leading layer dim, since scan slices it off before the gather runs.
+    if strategy == "full_shard" and fsdp_size > 1:
+        block_specs = jax.tree.map(
+            lambda s: P(*s[1:]),
+            p_specs["blocks"],
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+        def gather_block(bp):
+            return _gather_params(bp, block_specs)
+
+    else:
+        gather_block = None
+
+    def forward_loss(params_shard, inputs, targets, key):
+        if strategy == "full_shard" and fsdp_size > 1:
+            # Non-block leaves (embeddings, final norm) are gathered up
+            # front; each scanned layer gathers its own block just in time
+            # via block_transform, and remat re-gathers in backward.
+            params = {
+                k: (
+                    v
+                    if k == "blocks"
+                    else _gather_params(v, p_specs[k])
+                )
+                for k, v in params_shard.items()
+            }
+        else:
+            params = params_shard
+        logits = model.apply(
+            params,
+            inputs,
+            model_cfg,
+            deterministic=not train_mode,
+            dropout_key=key,
+            block_transform=gather_block,
+        )
+        return cross_entropy_loss(logits, targets)
+
+    grad_fn = jax.value_and_grad(forward_loss)
+
+    def step_impl(state: TrainState, batch: dict, dropout_key: jax.Array):
+        accum = batch["inputs"].shape[0]
+
+        # --- local gradient accumulation: NO collectives inside ----------
+        def scan_body(carry, xs):
+            grads_acc, loss_acc = carry
+            inputs, targets, idx = xs
+            key = jax.random.fold_in(dropout_key, idx)
+            loss, grads = grad_fn(state.params, inputs, targets, key)
+            return (
+                jax.tree.map(jnp.add, grads_acc, grads),
+                loss_acc + loss,
+            ), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (grads, loss_sum), _ = jax.lax.scan(
+            scan_body,
+            (zeros, jnp.zeros((), jnp.float32)),
+            (batch["inputs"], batch["targets"], jnp.arange(accum)),
+        )
+        grads = jax.tree.map(lambda g: g / accum, grads)
+        loss = loss_sum / accum
+
+        # --- the boundary: collectives fire here -------------------------
+        if strategy == "full_shard" and fsdp_size > 1:
+            # grads are already sharded (AD transposed the all_gather into a
+            # psum_scatter that SUMMED over fsdp); normalise that sum into a
+            # mean, then average over the pure-data axis.
+            grads = jax.tree.map(lambda g: g / fsdp_size, grads)
+            if "data" in dp_axes and mesh_cfg.data > 1:
+                grads = jax.lax.pmean(grads, "data")
+        elif strategy == "shard_grad_op" and fsdp_size > 1:
+            # ZeRO-2: reduce_scatter to shards (+ mean over data axis).
+            grads = _scatter_grads(grads, shard_specs, fsdp_size)
+            grads = jax.tree.map(lambda g: g / fsdp_size, grads)
+            if "data" in dp_axes and mesh_cfg.data > 1:
+                grads = jax.lax.pmean(grads, "data")
+        else:
+            # DDP: one all-reduce(AVG) over every batch axis.
+            for ax in dp_axes:
+                grads = jax.lax.pmean(grads, ax)
+
+        # loss all-reduce(AVG) (reference distributed_trainer.py:131-154).
+        for ax in dp_axes:
+            loss = jax.lax.pmean(loss, ax)
+
+        # --- update -------------------------------------------------------
+        if strategy == "shard_grad_op" and fsdp_size > 1:
+            # Sharded Adam update, then re-gather full params.
+            params_shard = jax.tree.map(
+                lambda p, spec: _shard_slice(p, spec, fsdp_size),
+                state.params,
+                shard_specs,
+            )
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, params_shard
+            )
+            new_params_shard = optax.apply_updates(params_shard, updates)
+            new_params = _gather_params(new_params_shard, shard_specs)
+        else:
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+
+        # grad_norm over the distributed grad tree (sharded leaves need a
+        # cross-shard sum of squares).
+        if strategy in ("full_shard", "shard_grad_op") and fsdp_size > 1:
+            sq = sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in jax.tree.leaves(grads)
+            )
+            grad_norm = jnp.sqrt(jax.lax.psum(sq, "fsdp"))
+        else:
+            grad_norm = optax.global_norm(grads)
+
+        metrics = {"loss": loss, "grad_norm": grad_norm}
+        return TrainState(new_params, new_opt_state, state.step + 1), metrics
+
+    smapped = shard_map(
+        step_impl,
+        mesh=mesh,
+        in_specs=(
+            TrainState(params=p_specs, opt_state=o_specs, step=P()),
+            {"inputs": batch_spec, "targets": batch_spec},
+            P(),
+        ),
+        out_specs=(
+            TrainState(params=p_specs, opt_state=o_specs, step=P()),
+            {"loss": P(), "grad_norm": P()},
+        ),
+        # Collectives make per-shard values replicated again; skip the
+        # varying-manual-axes bookkeeping (equivalence with the single-device
+        # step is asserted numerically in tests instead).
+        check_vma=False,
+    )
+    return jax.jit(smapped, donate_argnums=(0,))
+
+
+def _shard_slice(full, spec: P, fsdp_size: int):
+    """Take this device's fsdp slice of a replicated array (ZeRO-2 update)."""
+    dim = _sharded_dim(spec)
+    if dim is None:
+        return full
+    idx = jax.lax.axis_index("fsdp")
+    size = full.shape[dim] // fsdp_size
+    return jax.lax.dynamic_slice_in_dim(full, idx * size, size, axis=dim)

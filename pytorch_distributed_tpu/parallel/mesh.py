@@ -1,0 +1,86 @@
+"""Device-mesh construction and process identity.
+
+TPU-native replacement for the reference's process-group setup
+(reference train_ddp.py:23-36: init_process_group('nccl') + RANK/WORLD_SIZE/
+LOCAL_RANK env vars + cuda.set_device): here the runtime is
+``jax.distributed.initialize()`` (multi-host) plus a ``jax.sharding.Mesh``
+over the device slice; identity is ``jax.process_index()/process_count()``;
+there is no teardown (reference train_ddp.py:146's destroy_process_group has
+no analogue — XLA owns the channel lifetime).
+
+Mesh axes (MeshConfig.axis_order): data / fsdp / seq / tensor. Collectives
+ride ICI within a slice, DCN across slices; putting "data" outermost keeps
+the highest-volume gradient reductions on the fastest links when XLA lays
+device coordinates out innermost-last.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, PartitionSpec as P
+
+from pytorch_distributed_tpu.config import MeshConfig
+
+
+def initialize_distributed() -> None:
+    """Multi-host rendezvous (idempotent). On a single-process TPU or CPU
+    testbed this is a no-op; on a pod each host calls it once before any
+    devices are used (the torchrun-rendezvous analogue)."""
+    if jax.process_count() > 1:
+        return  # already initialised by the launcher
+    try:
+        jax.distributed.initialize()
+    except (ValueError, RuntimeError):
+        # Single-process: no coordinator configured — fine.
+        pass
+
+
+def process_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    """Build a Mesh of shape cfg.shape over the given (or all) devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = cfg.num_devices
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices ({cfg.shape}) but only "
+            f"{len(devices)} available"
+        )
+    shape = tuple(cfg.shape.values())
+    try:
+        arr = mesh_utils.create_device_mesh(
+            shape, devices=list(devices)[:n]
+        )
+    except (ValueError, NotImplementedError, AssertionError):
+        # Non-TPU topologies (CPU test meshes): plain reshape is fine.
+        arr = np.array(list(devices)[:n]).reshape(shape)
+    return Mesh(arr, axis_names=cfg.axis_order)
+
+
+def batch_partition_spec(cfg: MeshConfig) -> P:
+    """Global-batch sharding: batch dim split over data AND fsdp axes (FSDP
+    is data parallelism with sharded state — each fsdp shard still consumes
+    its own slice of the batch); sequence dim split over seq axis for
+    context parallelism. [A, B, T] batches shard B and T."""
+    batch_axes = tuple(
+        ax for ax in ("data", "fsdp") if getattr(cfg, ax) > 1
+    ) or None
+    seq_axis = "seq" if cfg.seq > 1 else None
+    return P(None, batch_axes, seq_axis)
+
+
+def data_parallel_size(cfg: MeshConfig) -> int:
+    """How many ways the batch is split (the 'world size' in the reference's
+    grad-accum rule, distributed_trainer.py:84-88)."""
+    return cfg.data * cfg.fsdp

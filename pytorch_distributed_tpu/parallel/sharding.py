@@ -1,0 +1,127 @@
+"""Parameter/optimizer-state sharding rules — the FSDP strategy table.
+
+Capability twin of the reference's three FSDP sharding strategies
+(reference train_fsdp.py:49-59):
+
+  full_shard     (ZeRO-3): params + grads + optimizer state sharded.
+                 XLA inserts all_gather before use and reduce_scatter on
+                 grads — exactly the collectives FSDP issues per wrapped
+                 block (reference :50-52), but placed by the SPMD
+                 partitioner instead of module hooks.
+  shard_grad_op  (ZeRO-2): params replicated; optimizer state sharded.
+                 The weight update runs on shards and re-gathers params —
+                 reduce_scatter(grads) + sharded update + all_gather(params).
+  no_shard       (DDP): everything replicated; gradient psum only.
+
+Sharding is expressed per-leaf as a NamedSharding over the mesh's "fsdp"
+axis: the largest dimension divisible by the axis size is sharded (prefer
+the trailing — usually feature — dim on ties, which keeps the contracting
+dim intact for the MXU). Stacked-block leaves [L, ...] therefore shard a
+weight dim, not L, so scan-over-layers slices stay local.
+
+Per-block granularity in the reference (wrap each transformer.h[i],
+train_fsdp.py:71-81) maps to scan-over-layers + remat here: only one
+layer's gathered params are live at a time.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.config import MeshConfig
+from pytorch_distributed_tpu.train.state import TrainState
+
+
+def _leaf_spec(
+    shape: tuple[int, ...],
+    axis_size: int,
+    axis_name: str,
+    *,
+    min_dim: int = 0,
+) -> P:
+    """Shard the largest divisible dim >= min_dim along axis_name
+    (ties -> last dim)."""
+    if axis_size == 1 or not shape:
+        return P()
+    best_dim, best_size = None, 0
+    for i, s in enumerate(shape):
+        if i >= min_dim and s % axis_size == 0 and s >= best_size and s > 1:
+            best_dim, best_size = i, s
+    if best_dim is None:
+        return P()  # small leaf (e.g. scalars, LN vectors) — replicate
+    spec = [None] * len(shape)
+    spec[best_dim] = axis_name
+    return P(*spec)
+
+
+def param_partition_specs(params, mesh_cfg: MeshConfig):
+    """PartitionSpec pytree for model params under the configured strategy.
+
+    Leaves under a top-level "blocks" key are layer-stacked [L, ...]; their
+    leading dim is never sharded so scan-over-layers slices stay local and
+    per-layer gathers (explicit FSDP) keep working.
+    """
+    if mesh_cfg.strategy in ("no_shard", "shard_grad_op") or mesh_cfg.fsdp == 1:
+        return jax.tree.map(lambda _: P(), params)
+
+    def spec_for(path, leaf):
+        stacked = bool(path) and getattr(path[0], "key", None) == "blocks"
+        return _leaf_spec(
+            tuple(leaf.shape),
+            mesh_cfg.fsdp,
+            "fsdp",
+            min_dim=1 if stacked else 0,
+        )
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def opt_state_partition_specs(opt_state, params_specs, mesh_cfg: MeshConfig):
+    """Optimizer-state sharding. Adam moments mirror the params tree shape;
+    for full_shard they follow the param specs, for shard_grad_op they are
+    sharded even though params are replicated (ZeRO-2), for no_shard
+    replicated. Scalar leaves (step counts) stay replicated."""
+    del params_specs  # moments share param shapes; specs derive from shapes
+    if mesh_cfg.strategy == "no_shard" or mesh_cfg.fsdp == 1:
+        return jax.tree.map(lambda _: P(), opt_state)
+
+    def leaf_spec(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if not shape:
+            return P()
+        stacked = any(getattr(p, "key", None) == "blocks" for p in path)
+        return _leaf_spec(
+            shape, mesh_cfg.fsdp, "fsdp", min_dim=1 if stacked else 0
+        )
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, opt_state)
+
+
+def state_shardings(state: TrainState, mesh: Mesh, mesh_cfg: MeshConfig):
+    """NamedSharding pytree matching a TrainState."""
+    p_specs = param_partition_specs(state.params, mesh_cfg)
+    o_specs = opt_state_partition_specs(state.opt_state, p_specs, mesh_cfg)
+
+    def to_sharding(spec):
+        return NamedSharding(mesh, spec)
+
+    return TrainState(
+        params=jax.tree.map(to_sharding, p_specs),
+        opt_state=jax.tree.map(to_sharding, o_specs),
+        step=NamedSharding(mesh, P()),
+    )
+
+
+def shard_train_state(
+    state: TrainState, mesh: Mesh, mesh_cfg: MeshConfig
+) -> tuple[TrainState, TrainState]:
+    """Place a host/replicated TrainState onto the mesh per the strategy.
+
+    Returns (sharded_state, shardings). This is the moment FSDP 'wraps' the
+    model in the reference (train_fsdp.py:64-81) — here it is just a
+    device_put with sharding annotations; XLA does the rest.
+    """
+    shardings = state_shardings(state, mesh, mesh_cfg)
+    sharded = jax.device_put(state, shardings)
+    return sharded, shardings

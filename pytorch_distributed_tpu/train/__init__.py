@@ -1,0 +1,3 @@
+from pytorch_distributed_tpu.train.state import TrainState  # noqa: F401
+from pytorch_distributed_tpu.train.optim import make_optimizer, lr_at_step  # noqa: F401
+from pytorch_distributed_tpu.train.trainer import Trainer  # noqa: F401

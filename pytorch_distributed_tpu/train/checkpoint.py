@@ -1,0 +1,117 @@
+"""Checkpoint save/load for arbitrary train-state pytrees.
+
+Capability twin of reference train/trainer.py:117-141 (torch.save/load of
+{model, optimizer, step, lr_scheduler} state dicts): here the unit is the
+whole TrainState pytree ({params, opt_state, step} — the LR schedule is a
+pure function of step, so it needs no separate state).
+
+Format: one ``.npz`` with flattened leaves keyed by their tree path, plus a
+``meta.json`` sidecar with the structure and metadata. Self-contained numpy —
+readable without JAX — and path-keyed, so checkpoints survive refactors that
+reorder (but not rename) the tree. Save is atomic (write temp dir, rename).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def save_checkpoint(
+    directory: str | Path, state: Any, *, metadata: dict | None = None
+) -> str:
+    """Serialise a pytree of arrays. Only the calling process writes
+    (callers gate on process 0, reference distributed_trainer.py:214-221)."""
+    directory = Path(directory)
+    os.makedirs(directory.parent if directory.suffix else directory.parent, exist_ok=True)
+    leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
+    arrays = {}
+    for path, leaf in leaves_with_paths:
+        arrays[_path_str(path)] = np.asarray(jax.device_get(leaf))
+
+    tmp = Path(tempfile.mkdtemp(dir=directory.parent, prefix=".ckpt_tmp_"))
+    try:
+        np.savez(tmp / "arrays.npz", **arrays)
+        meta = {
+            "format": "pdtpu-ckpt-v1",
+            "keys": sorted(arrays.keys()),
+            "metadata": metadata or {},
+        }
+        (tmp / "meta.json").write_text(json.dumps(meta, indent=1))
+        if directory.exists():
+            shutil.rmtree(directory)
+        os.replace(tmp, directory)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return str(directory)
+
+
+def load_checkpoint(directory: str | Path, like: Any) -> Any:
+    """Restore into the structure of ``like`` (a template pytree, e.g. a
+    freshly initialised TrainState — the analogue of load_state_dict
+    restoring into constructed modules, reference trainer.py:130-141)."""
+    directory = Path(directory)
+    with np.load(directory / "arrays.npz") as data:
+        arrays = {k: data[k] for k in data.files}
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    new_leaves = []
+    for path, leaf in leaves_with_paths:
+        key = _path_str(path)
+        if key not in arrays:
+            raise KeyError(
+                f"checkpoint {directory} missing leaf {key!r}; "
+                f"has {len(arrays)} leaves"
+            )
+        got = arrays[key]
+        want_shape = tuple(leaf.shape)
+        if tuple(got.shape) != want_shape:
+            raise ValueError(
+                f"checkpoint leaf {key!r} shape {got.shape} != expected {want_shape}"
+            )
+        new_leaves.append(got.astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def read_metadata(directory: str | Path) -> dict:
+    meta = json.loads((Path(directory) / "meta.json").read_text())
+    return meta.get("metadata", {})
+
+
+def latest_checkpoint(checkpoint_root: str | Path) -> str | None:
+    """Find the newest ``checkpoint_step_{n}`` dir (reference naming
+    trainer.py:100-106)."""
+    root = Path(checkpoint_root)
+    if not root.exists():
+        return None
+    best, best_step = None, -1
+    for child in root.iterdir():
+        if child.is_dir() and child.name.startswith("checkpoint_step_"):
+            try:
+                step = int(child.name.rsplit("_", 1)[1])
+            except ValueError:
+                continue
+            if step > best_step:
+                best, best_step = str(child), step
+    return best

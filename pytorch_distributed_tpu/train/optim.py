@@ -1,0 +1,63 @@
+"""Optimizer + LR schedule.
+
+Capability twin of the reference's AdamW(lr=3e-4, wd=0.1) +
+CosineAnnealingLR(T_max=num_steps, eta_min=0.1*lr)
+(reference train_baseline.py:61-64), built on optax. Weight decay is applied
+to all params, matching torch AdamW's default behavior in the reference
+(no param-group exclusions there).
+"""
+
+from __future__ import annotations
+
+import math
+
+import optax
+
+from pytorch_distributed_tpu.config import TrainConfig
+
+
+def make_schedule(cfg: TrainConfig):
+    peak = cfg.learning_rate
+    floor = cfg.min_lr_ratio * peak
+    if cfg.lr_schedule == "constant":
+        sched = optax.constant_schedule(peak)
+    elif cfg.lr_schedule == "cosine":
+        # torch CosineAnnealingLR semantics: lr(t) = floor +
+        # (peak-floor) * (1 + cos(pi * t / T_max)) / 2.
+        sched = optax.cosine_decay_schedule(
+            init_value=peak,
+            decay_steps=max(cfg.num_steps, 1),
+            alpha=cfg.min_lr_ratio,
+        )
+    else:
+        raise KeyError(f"unknown lr_schedule {cfg.lr_schedule!r}")
+    if cfg.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, peak, cfg.warmup_steps)
+        sched = optax.join_schedules([warmup, sched], [cfg.warmup_steps])
+    return sched
+
+
+def lr_at_step(cfg: TrainConfig, step: int) -> float:
+    """Host-side schedule evaluation for logging (reference logs lr from the
+    scheduler, train/trainer.py:94-97)."""
+    if cfg.warmup_steps > 0 and step < cfg.warmup_steps:
+        return cfg.learning_rate * step / cfg.warmup_steps
+    t = step - cfg.warmup_steps
+    peak, floor = cfg.learning_rate, cfg.min_lr_ratio * cfg.learning_rate
+    if cfg.lr_schedule == "constant":
+        return peak
+    tmax = max(cfg.num_steps, 1)
+    frac = min(t / tmax, 1.0)
+    return floor + (peak - floor) * 0.5 * (1.0 + math.cos(math.pi * frac))
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    steps = [
+        optax.clip_by_global_norm(cfg.grad_clip_norm)
+        if cfg.grad_clip_norm is not None
+        else optax.identity(),
+        optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps),
+        optax.add_decayed_weights(cfg.weight_decay),
+        optax.scale_by_learning_rate(make_schedule(cfg)),
+    ]
+    return optax.chain(*steps)

@@ -1,0 +1,28 @@
+"""Train state: the single pytree carried through the jitted step.
+
+The reference scatters this state across mutable objects (model params inside
+nn.Module, optimizer state inside AdamW, step counter on the Trainer —
+reference train/trainer.py:36-47). TPU-natively it is one immutable pytree so
+the whole update is a pure function ``(state, batch) -> (state, metrics)``
+that jit/pjit can shard end-to-end.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+
+
+class TrainState(NamedTuple):
+    params: Any
+    opt_state: Any
+    step: jax.Array  # scalar int32
+
+
+def init_train_state(params, tx) -> TrainState:
+    import jax.numpy as jnp
+
+    return TrainState(
+        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
+    )

@@ -1,0 +1,6 @@
+from pytorch_distributed_tpu.utils.logging import get_logger, log_on_process_zero  # noqa: F401
+from pytorch_distributed_tpu.utils.pytree import (  # noqa: F401
+    param_count,
+    tree_bytes,
+    tree_global_norm,
+)

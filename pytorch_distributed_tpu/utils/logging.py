@@ -1,0 +1,40 @@
+"""Minimal host-side logging with process-0 gating.
+
+The reference logs via bare ``print`` gated on rank 0
+(reference train/distributed_trainer.py:201-212, SURVEY.md §5.5). Here the
+process identity comes from ``jax.process_index()`` instead of RANK env vars.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+_CONFIGURED = False
+
+
+def get_logger(name: str = "pdtpu") -> logging.Logger:
+    global _CONFIGURED
+    logger = logging.getLogger(name)
+    if not _CONFIGURED:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s %(name)s] %(message)s", "%H:%M:%S")
+        )
+        root = logging.getLogger("pdtpu")
+        root.addHandler(handler)
+        root.setLevel(logging.INFO)
+        root.propagate = False
+        _CONFIGURED = True
+    return logger
+
+
+def is_process_zero() -> bool:
+    return jax.process_index() == 0
+
+
+def log_on_process_zero(message: str, logger: logging.Logger | None = None) -> None:
+    if is_process_zero():
+        (logger or get_logger()).info(message)

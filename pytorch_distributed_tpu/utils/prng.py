@@ -1,0 +1,35 @@
+"""Deterministic PRNG plumbing.
+
+The reference gets cross-rank determinism from a single global
+``torch.manual_seed(42)`` on every rank (reference train_ddp.py:73-76). The
+JAX-native equivalent is explicit key splitting: one root key derived from the
+seed, with named folds for each consumer (init / dropout / data), and per-step
+per-layer folds so dropout masks are unique but reproducible.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+# Stable small integers for key domains — folded into the root key so that
+# adding a new consumer never shifts existing streams.
+_DOMAINS = {"init": 0, "dropout": 1, "data": 2, "misc": 3}
+
+
+def domain_key(seed_or_key: int | jax.Array, domain: str) -> jax.Array:
+    key = (
+        jax.random.key(seed_or_key)
+        if isinstance(seed_or_key, int)
+        else seed_or_key
+    )
+    return jax.random.fold_in(key, _DOMAINS[domain])
+
+
+def step_key(key: jax.Array, step: jax.Array | int) -> jax.Array:
+    """Per-step dropout key: fold the step counter in (traceable under jit)."""
+    return jax.random.fold_in(key, step)

@@ -1,0 +1,34 @@
+"""Pytree helpers: parameter counting, byte accounting, norms."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def param_count(tree) -> int:
+    leaves = jax.tree.leaves(tree)
+    return int(sum(int(np.prod(leaf.shape)) for leaf in leaves))
+
+
+def tree_bytes(tree) -> int:
+    leaves = jax.tree.leaves(tree)
+    return int(
+        sum(int(np.prod(leaf.shape)) * leaf.dtype.itemsize for leaf in leaves)
+    )
+
+
+def tree_global_norm(tree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves)
+    )
+
+
+def tree_cast(tree, dtype):
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)

@@ -1,0 +1,46 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax initialises.
+
+This is the TPU-native answer to "test multi-node without a cluster"
+(SURVEY.md §4): all mesh/collective code paths run on
+``--xla_force_host_platform_device_count=8`` CPU devices.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon environment's site hook re-forces JAX_PLATFORMS=axon (real TPU), so
+# the env var alone is not enough — pin the platform through jax.config too.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from pytorch_distributed_tpu.config import ModelConfig  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tiny_config() -> ModelConfig:
+    return ModelConfig(
+        vocab_size=101,
+        n_ctx=16,
+        n_embd=32,
+        n_layer=2,
+        n_head=4,
+        dtype="float32",
+        remat="dots",
+    )
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]

@@ -1,0 +1,50 @@
+import pytest
+
+from pytorch_distributed_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+    model_config,
+)
+
+
+def test_presets_match_reference_shapes():
+    # Shapes the reference pulls via AutoConfig (train_baseline.py:24 uses
+    # gpt2-large; memory_analysis.py:136 uses gpt2).
+    small = model_config("gpt2")
+    assert (small.n_embd, small.n_layer, small.n_head) == (768, 12, 12)
+    large = model_config("gpt2-large")
+    assert (large.n_embd, large.n_layer, large.n_head) == (1280, 36, 20)
+    assert large.vocab_size == 50257 and large.n_ctx == 1024
+
+    llama = model_config("llama3-1b")
+    assert llama.family == "llama" and llama.kv_heads == 8
+
+
+def test_preset_overrides_and_errors():
+    c = model_config("gpt2", n_layer=2)
+    assert c.n_layer == 2
+    with pytest.raises(KeyError):
+        model_config("nope")
+    with pytest.raises(ValueError):
+        ModelConfig(n_embd=30, n_head=4)
+
+
+def test_grad_accum_math():
+    # Single-device rule (reference train/trainer.py:31-34): 32/8 = 4.
+    t = TrainConfig(global_batch_size=32, micro_batch_size=8)
+    assert t.grad_accum_steps() == 4
+    # Distributed rule (reference train/distributed_trainer.py:84-88):
+    # global // (micro * world) — 32/(8*2) = 2, 32/(8*4) = 1.
+    assert t.grad_accum_steps(2) == 2
+    assert t.grad_accum_steps(4) == 1
+    with pytest.raises(ValueError):
+        t.grad_accum_steps(3)
+
+
+def test_mesh_config():
+    m = MeshConfig(data=2, fsdp=4)
+    assert m.num_devices == 8
+    assert m.shape == {"data": 2, "fsdp": 4, "seq": 1, "tensor": 1}
+    with pytest.raises(ValueError):
+        MeshConfig(strategy="zeRO9000")

@@ -1,0 +1,154 @@
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.data import bin_format
+from pytorch_distributed_tpu.data.distributed_loader import (
+    DistributedTokenShardLoader,
+)
+from pytorch_distributed_tpu.data.loader import TokenShardLoader
+from pytorch_distributed_tpu.data.synthetic import (
+    make_synthetic_shards,
+    synthetic_token_stream,
+)
+
+
+@pytest.fixture()
+def shards(tmp_path):
+    """Two tiny shards with globally increasing token values 0..N-1 so
+    positions are directly readable from values."""
+    n0, n1 = 600, 500
+    p0 = tmp_path / "t_000000.bin"
+    p1 = tmp_path / "t_000001.bin"
+    bin_format.write_shard(p0, np.arange(n0, dtype=np.uint16))
+    bin_format.write_shard(p1, np.arange(n0, n0 + n1, dtype=np.uint16))
+    return [str(p0), str(p1)]
+
+
+def test_bin_format_roundtrip(tmp_path):
+    tokens = np.array([5, 0, 65535, 123], dtype=np.uint16)
+    path = tmp_path / "x.bin"
+    bin_format.write_shard(path, tokens)
+    info = bin_format.read_header(path)
+    assert info == {"magic": 20240520, "version": 1, "token_count": 4}
+    got = bin_format.read_tokens(path)
+    np.testing.assert_array_equal(np.asarray(got), tokens)
+    got2 = bin_format.read_tokens(path, mmap=False)
+    np.testing.assert_array_equal(np.asarray(got2), tokens)
+
+
+def test_bin_format_rejects_bad_magic(tmp_path):
+    path = tmp_path / "bad.bin"
+    bin_format.write_shard(path, np.arange(4, dtype=np.uint16))
+    raw = bytearray(path.read_bytes())
+    raw[0] = 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(bin_format.ShardFormatError):
+        bin_format.read_header(path)
+
+
+def test_sequential_loader_semantics(shards):
+    # B=2, T=8: sequences pull T+1 tokens, advance by T (reference
+    # data_loader.py:137-164 — consecutive sequences overlap by 1 token).
+    loader = TokenShardLoader(shards, batch_size=2, sequence_length=8)
+    it = iter(loader)
+    inputs, targets = next(it)
+    assert inputs.shape == (2, 8) and inputs.dtype == np.int32
+    np.testing.assert_array_equal(inputs[0], np.arange(0, 8))
+    np.testing.assert_array_equal(targets[0], np.arange(1, 9))
+    np.testing.assert_array_equal(inputs[1], np.arange(8, 16))
+    np.testing.assert_array_equal(targets[1], np.arange(9, 17))
+
+    # Fresh __iter__ restarts from the first shard (reference :172-175).
+    inputs2, _ = next(iter(loader))
+    np.testing.assert_array_equal(inputs2, inputs)
+
+
+def test_sequential_loader_shard_switch_and_exhaustion(shards):
+    # T=64: shard 0 has 600 tokens -> switch when pos+64 >= 600, i.e. after
+    # 9 sequences (pos=576); shard 1 (500 tokens) gives 7 more center checks.
+    loader = TokenShardLoader(shards, batch_size=1, sequence_length=64)
+    batches = list(loader)
+    firsts = [int(b[0][0, 0]) for b in batches]
+    # 9 sequences from shard 0 (starts 0,64,...,512) then shard 1 (starts 600+).
+    assert firsts[:9] == [64 * i for i in range(9)]
+    assert firsts[9] == 600
+    # exhaustion: total batches = 9 + floor-ish of shard 1
+    assert len(batches) == 9 + 7
+    assert loader.get_total_tokens() == 1100
+
+
+def test_distributed_rank_slicing(shards):
+    # world=2, B=2, T=4 -> num_tokens_local=8; rank r takes
+    # [pos + 8r, pos + 8r + 9); pos advances by 16 (reference worked example
+    # distributed_data_loader.py:16-24).
+    r0 = DistributedTokenShardLoader(
+        shards, 2, 4, rank=0, world_size=2
+    )
+    r1 = DistributedTokenShardLoader(
+        shards, 2, 4, rank=1, world_size=2
+    )
+    b0 = next(iter(r0))
+    b1 = next(iter(r1))
+    np.testing.assert_array_equal(b0[0].ravel(), np.arange(0, 8))
+    np.testing.assert_array_equal(b0[1].ravel(), np.arange(1, 9))
+    np.testing.assert_array_equal(b1[0].ravel(), np.arange(8, 16))
+    np.testing.assert_array_equal(b1[1].ravel(), np.arange(9, 17))
+
+    # Second batch starts at pos=16.
+    it0 = iter(r0)
+    next(it0)
+    second = next(it0)
+    np.testing.assert_array_equal(second[0].ravel(), np.arange(16, 24))
+
+
+def test_distributed_world1_matches_contiguous_stream(shards):
+    """world=1 distributed loader yields the same token stream as reading
+    contiguous B*T chunks — determinism/equivalence by construction
+    (reference distributed_data_loader.py:21-24)."""
+    loader = DistributedTokenShardLoader(shards, 2, 8, rank=0, world_size=1)
+    stream = []
+    for inputs, _ in loader:
+        stream.append(inputs.ravel())
+    stream = np.concatenate(stream)
+    # Contiguous within each shard, advancing 16/batch: shard0 has 600 tokens
+    # -> 37 batches (37*16=592 <= 599), then shard1.
+    np.testing.assert_array_equal(stream[: 37 * 16], np.arange(37 * 16))
+    assert int(stream[37 * 16]) == 600
+
+
+def test_distributed_ranks_partition_global_stream(shards):
+    """Interleaving all ranks' chunks reconstructs the global contiguous
+    stream — 'all ranks process data from the same global sequence'."""
+    world = 4
+    loaders = [
+        DistributedTokenShardLoader(shards, 1, 8, rank=r, world_size=world)
+        for r in range(world)
+    ]
+    iters = [iter(ld) for ld in loaders]
+    global_stream = []
+    for _ in range(3):  # 3 rounds
+        for it in iters:
+            inputs, _ = next(it)
+            global_stream.append(inputs.ravel())
+    got = np.concatenate(global_stream)
+    np.testing.assert_array_equal(got, np.arange(len(got)))
+
+
+def test_distributed_rank_validation(shards):
+    with pytest.raises(ValueError):
+        DistributedTokenShardLoader(shards, 1, 8, rank=5, world_size=2)
+
+
+def test_synthetic_shards_roundtrip(tmp_path):
+    paths = make_synthetic_shards(
+        tmp_path, num_shards=2, tokens_per_shard=1000, vocab_size=101, seed=7
+    )
+    assert len(paths) == 2
+    loader = TokenShardLoader(paths, batch_size=2, sequence_length=16)
+    inputs, targets = next(iter(loader))
+    assert inputs.max() < 101 and inputs.min() >= 0
+    # Deterministic across regeneration.
+    again = synthetic_token_stream(1000, 101, 7)
+    np.testing.assert_array_equal(
+        np.asarray(bin_format.read_tokens(paths[0])), again
+    )

@@ -1,0 +1,93 @@
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from pytorch_distributed_tpu.data import (
+    DistributedTokenShardLoader,
+    make_synthetic_shards,
+)
+from pytorch_distributed_tpu.models import get_model
+from pytorch_distributed_tpu.parallel import make_mesh
+from pytorch_distributed_tpu.train.checkpoint import latest_checkpoint
+from pytorch_distributed_tpu.train.distributed_trainer import DistributedTrainer
+from pytorch_distributed_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return ModelConfig(
+        vocab_size=128, n_ctx=16, n_embd=32, n_layer=2, n_head=4,
+        dtype="float32", embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+    )
+
+
+@pytest.fixture(scope="module")
+def shards(tmp_path_factory):
+    return make_synthetic_shards(
+        tmp_path_factory.mktemp("ddata"),
+        num_shards=1,
+        tokens_per_shard=30_000,
+        vocab_size=128,
+        seed=11,
+    )
+
+
+def _loader(shards, global_rows):
+    # Single host assembles the global batch: world=1 slice of the global
+    # stream with B = micro * dp rows (equals the rank-interleaved stream).
+    return DistributedTokenShardLoader(
+        shards, global_rows, 16, rank=0, world_size=1
+    )
+
+
+@pytest.mark.parametrize("path", ["auto", "explicit"])
+def test_distributed_trainer_runs_and_matches_single(
+    cfg, shards, tmp_path, path, eight_devices
+):
+    tcfg = TrainConfig(
+        global_batch_size=16,
+        micro_batch_size=1,  # per-device; dp world = 8 -> accum = 2
+        num_steps=4,
+        learning_rate=1e-3,
+        log_every_n_steps=2,
+        save_every_n_steps=4,
+        checkpoint_dir=str(tmp_path / f"ck_{path}"),
+    )
+    mcfg = MeshConfig(data=2, fsdp=4, strategy="full_shard")
+    mesh = make_mesh(mcfg)
+    model = get_model(cfg)
+    dtr = DistributedTrainer(
+        model, cfg, tcfg, mesh, mcfg, path=path
+    )
+    assert dtr.accum == 2
+    state, history = dtr.train(_loader(shards, 8))
+    assert int(jax.device_get(state.step)) == 4
+    assert latest_checkpoint(tcfg.checkpoint_dir) is not None
+
+    # Single-device run on the same global stream must match exactly.
+    scfg = TrainConfig(
+        global_batch_size=16, micro_batch_size=8, num_steps=4,
+        learning_rate=1e-3, log_every_n_steps=2,
+    )
+    st = Trainer(model, cfg, scfg)
+    sstate, shist = st.train(_loader(shards, 8))
+    np.testing.assert_allclose(
+        history[-1]["loss"], shist[-1]["loss"], atol=1e-5
+    )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(state.params)),
+        jax.tree.leaves(jax.device_get(sstate.params)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_distributed_trainer_requires_init(cfg, eight_devices):
+    tcfg = TrainConfig(global_batch_size=8, micro_batch_size=1, num_steps=1)
+    mcfg = MeshConfig(data=8)
+    mesh = make_mesh(mcfg)
+    dtr = DistributedTrainer(get_model(cfg), cfg, tcfg, mesh, mcfg)
+    with pytest.raises(ValueError):
+        DistributedTrainer(
+            get_model(cfg), cfg, tcfg, mesh, mcfg, path="warp"
+        )

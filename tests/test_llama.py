@@ -1,0 +1,103 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import ModelConfig, model_config
+from pytorch_distributed_tpu.models import get_model, llama
+from pytorch_distributed_tpu.ops.rope import apply_rope, rope_angles
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    return ModelConfig(
+        family="llama",
+        vocab_size=101,
+        n_ctx=32,
+        n_embd=32,
+        n_layer=2,
+        n_head=4,
+        n_kv_head=2,
+        activation_function="silu",
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+        resid_pdrop=0.0,
+        dtype="float32",
+    )
+
+
+def test_llama_forward_shapes(tiny_llama):
+    cfg = tiny_llama
+    model = get_model(cfg)
+    params = model.init(jax.random.key(0), cfg)
+    ids = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    logits = model.apply(params, ids, cfg)
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_llama_inner_dim_rule():
+    # n_inner=None -> 8/3 rule rounded up to x256 for llama family.
+    cfg = ModelConfig(family="llama", n_embd=4096, n_head=32)
+    assert cfg.inner_dim == ((8 * 4096 // 3) + 255) // 256 * 256 == 11008
+    # Presets carry explicit values (llama3-8b uses 14336).
+    assert model_config("llama3-8b").inner_dim == 14336
+
+
+def test_llama_causality(tiny_llama):
+    cfg = tiny_llama
+    model = get_model(cfg)
+    params = model.init(jax.random.key(0), cfg)
+    ids = np.asarray(
+        jax.random.randint(jax.random.key(1), (1, 32), 0, cfg.vocab_size)
+    )
+    j = 20
+    ids2 = ids.copy()
+    ids2[0, j] = (ids2[0, j] + 1) % cfg.vocab_size
+    l1 = np.asarray(model.apply(params, jnp.asarray(ids), cfg))
+    l2 = np.asarray(model.apply(params, jnp.asarray(ids2), cfg))
+    np.testing.assert_allclose(l1[0, :j], l2[0, :j], atol=1e-5)
+    assert not np.allclose(l1[0, j:], l2[0, j:], atol=1e-5)
+
+
+def test_rope_properties():
+    """Rotation preserves norms and depends only on relative positions for
+    dot products."""
+    d = 16
+    cos, sin = rope_angles(8, d, 10000.0)
+    x = jax.random.normal(jax.random.key(0), (1, 8, 2, d))
+    xr = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(x), axis=-1),
+        np.linalg.norm(np.asarray(xr), axis=-1),
+        rtol=1e-5,
+    )
+    # Relative-position property: <R_i q, R_j k> == <R_{i+s} q, R_{j+s} k>.
+    q = jax.random.normal(jax.random.key(1), (1, 1, 1, d))
+    k = jax.random.normal(jax.random.key(2), (1, 1, 1, d))
+    cos8, sin8 = rope_angles(8, d, 10000.0)
+
+    def rot(x, pos):
+        return apply_rope(x, cos8[pos : pos + 1], sin8[pos : pos + 1])
+
+    dot_a = np.asarray(jnp.sum(rot(q, 2) * rot(k, 5)))
+    dot_b = np.asarray(jnp.sum(rot(q, 0) * rot(k, 3)))
+    np.testing.assert_allclose(dot_a, dot_b, rtol=1e-4)
+
+
+def test_llama_flash_matches_naive(tiny_llama):
+    cfg_naive = tiny_llama
+    cfg_flash = tiny_llama.replace(attention_impl="flash")
+    model = get_model(cfg_naive)
+    params = model.init(jax.random.key(0), cfg_naive)
+    ids = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg_naive.vocab_size)
+    l_naive = model.apply(params, ids, cfg_naive)
+    l_flash = model.apply(params, ids, cfg_flash)
+    np.testing.assert_allclose(
+        np.asarray(l_naive), np.asarray(l_flash), atol=2e-4
+    )
+
+
+def test_bad_attention_impl_rejected():
+    with pytest.raises(ValueError):
+        ModelConfig(attention_impl="warp9")

@@ -1,0 +1,132 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.models import gpt2
+from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.utils.pytree import param_count
+
+
+def _ids(cfg, batch=2, seed=1):
+    return jax.random.randint(
+        jax.random.key(seed), (batch, cfg.n_ctx), 0, cfg.vocab_size
+    )
+
+
+def test_forward_shapes_and_dtype(tiny_config):
+    cfg = tiny_config
+    params = gpt2.init(jax.random.key(0), cfg)
+    logits = gpt2.apply(params, _ids(cfg), cfg)
+    assert logits.shape == (2, cfg.n_ctx, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_param_count_gpt2_small_exact():
+    # GPT-2 124M: the canonical count for (768, 12, 12, 50257 vocab, 1024 ctx)
+    # with tied head is 124,439,808.
+    from pytorch_distributed_tpu.config import model_config
+
+    cfg = model_config("gpt2")
+    shapes = jax.eval_shape(lambda k: gpt2.init(k, cfg), jax.random.key(0))
+    total = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+    assert total == 124_439_808
+
+
+def test_init_distributions(tiny_config):
+    """GPT-2 init semantics (reference my_gpt2.py:216-244): linear/wte
+    N(0,0.02), wpe N(0,0.01), LN scale=1 bias=0, linear bias=0."""
+    cfg = tiny_config.replace(n_embd=64, n_layer=4, vocab_size=1000, n_ctx=512)
+    params = gpt2.init(jax.random.key(0), cfg)
+    assert np.std(np.asarray(params["wte"])) == pytest.approx(0.02, rel=0.1)
+    assert np.std(np.asarray(params["wpe"])) == pytest.approx(0.01, rel=0.1)
+    b = params["blocks"]
+    assert np.std(np.asarray(b["attn"]["c_attn"]["kernel"])) == pytest.approx(
+        0.02, rel=0.1
+    )
+    np.testing.assert_array_equal(np.asarray(b["attn"]["c_attn"]["bias"]), 0.0)
+    np.testing.assert_array_equal(np.asarray(b["ln_1"]["scale"]), 1.0)
+    np.testing.assert_array_equal(np.asarray(b["ln_1"]["bias"]), 0.0)
+    np.testing.assert_array_equal(np.asarray(params["ln_f"]["scale"]), 1.0)
+
+
+def test_causality(tiny_config):
+    """Perturbing position j must not change logits at positions < j."""
+    cfg = tiny_config
+    params = gpt2.init(jax.random.key(0), cfg)
+    ids = np.asarray(_ids(cfg, batch=1))
+    j = 10
+    ids2 = ids.copy()
+    ids2[0, j] = (ids2[0, j] + 1) % cfg.vocab_size
+    l1 = np.asarray(gpt2.apply(params, jnp.asarray(ids), cfg))
+    l2 = np.asarray(gpt2.apply(params, jnp.asarray(ids2), cfg))
+    np.testing.assert_allclose(l1[0, :j], l2[0, :j], atol=1e-5)
+    assert not np.allclose(l1[0, j:], l2[0, j:], atol=1e-5)
+
+
+def test_remat_modes_agree(tiny_config):
+    """Selective checkpointing must not change the math (reference
+    my_gpt2.py:175-183 is a memory optimisation only)."""
+    cfg_none = tiny_config.replace(remat="none")
+    params = gpt2.init(jax.random.key(0), cfg_none)
+    ids = _ids(cfg_none)
+
+    def loss(p, cfg):
+        return cross_entropy_loss(gpt2.apply(p, ids, cfg), ids)
+
+    for mode in ("dots", "full", "dots_no_batch"):
+        cfg_m = tiny_config.replace(remat=mode)
+        np.testing.assert_allclose(
+            float(loss(params, cfg_none)), float(loss(params, cfg_m)), rtol=1e-6
+        )
+        g0 = jax.grad(loss)(params, cfg_none)
+        g1 = jax.grad(loss)(params, cfg_m)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_dropout_train_vs_eval(tiny_config):
+    cfg = tiny_config
+    params = gpt2.init(jax.random.key(0), cfg)
+    ids = _ids(cfg)
+    eval_logits = gpt2.apply(params, ids, cfg)
+    t1 = gpt2.apply(
+        params, ids, cfg, deterministic=False, dropout_key=jax.random.key(5)
+    )
+    t2 = gpt2.apply(
+        params, ids, cfg, deterministic=False, dropout_key=jax.random.key(6)
+    )
+    t1b = gpt2.apply(
+        params, ids, cfg, deterministic=False, dropout_key=jax.random.key(5)
+    )
+    # Train mode differs from eval; different keys differ; same key reproduces.
+    assert not np.allclose(np.asarray(eval_logits), np.asarray(t1))
+    assert not np.allclose(np.asarray(t1), np.asarray(t2))
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t1b))
+    # Missing key in train mode is an error.
+    with pytest.raises(ValueError):
+        gpt2.apply(params, ids, cfg, deterministic=False)
+
+
+def test_seq_len_validation(tiny_config):
+    cfg = tiny_config
+    params = gpt2.init(jax.random.key(0), cfg)
+    too_long = jnp.zeros((1, cfg.n_ctx + 1), dtype=jnp.int32)
+    with pytest.raises(ValueError):
+        gpt2.apply(params, too_long, cfg)
+
+
+def test_shorter_sequence_ok(tiny_config):
+    cfg = tiny_config
+    params = gpt2.init(jax.random.key(0), cfg)
+    ids = jnp.zeros((1, 8), dtype=jnp.int32)
+    assert gpt2.apply(params, ids, cfg).shape == (1, 8, cfg.vocab_size)
+
+
+def test_loss_near_uniform_at_init(tiny_config):
+    """At init, CE should be close to ln(V) — catches scale bugs."""
+    cfg = tiny_config
+    params = gpt2.init(jax.random.key(0), cfg)
+    ids = _ids(cfg, batch=4)
+    loss = float(cross_entropy_loss(gpt2.apply(params, ids, cfg), ids))
+    assert abs(loss - np.log(cfg.vocab_size)) < 0.5

@@ -1,0 +1,161 @@
+"""Single-device vs multi-device equivalence — the core correctness contract
+for DP/FSDP (SURVEY.md §4: 'single-vs-multi-device loss equivalence' on
+virtual CPU devices).
+
+All tests run on 8 virtual CPU devices (conftest). Dropout is disabled in
+these configs: the auto (pjit) path draws one global dropout mask while the
+explicit (shard_map) path draws per-shard masks from the replicated key, so
+their trainings only coincide exactly when deterministic. (The reference has
+the same property: seed 42 on every rank makes torch dropout masks identical
+across ranks, train_ddp.py:73-76.)
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from pytorch_distributed_tpu.models import get_model
+from pytorch_distributed_tpu.parallel import (
+    make_mesh,
+    make_parallel_train_step,
+    shard_train_state,
+)
+from pytorch_distributed_tpu.parallel.explicit import make_explicit_train_step
+from pytorch_distributed_tpu.parallel.mesh import (
+    batch_partition_spec,
+    data_parallel_size,
+)
+from pytorch_distributed_tpu.parallel.sharding import param_partition_specs
+from pytorch_distributed_tpu.train.optim import make_optimizer
+from pytorch_distributed_tpu.train.state import init_train_state
+from pytorch_distributed_tpu.train.trainer import make_train_step
+from pytorch_distributed_tpu.utils.prng import domain_key
+
+
+@pytest.fixture(scope="module")
+def setup(eight_devices):
+    cfg = ModelConfig(
+        vocab_size=128, n_ctx=16, n_embd=64, n_layer=2, n_head=4,
+        dtype="float32", embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+    )
+    tcfg = TrainConfig(
+        global_batch_size=16, micro_batch_size=16, num_steps=4,
+        learning_rate=1e-3,
+    )
+    model = get_model(cfg)
+    tx = make_optimizer(tcfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "inputs": rng.integers(0, 128, (2, 16, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (2, 16, 16)).astype(np.int32),
+    }
+    state0 = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    sstep = make_train_step(model, cfg, tx, donate=False)
+    ref_state, ref_metrics = sstep(state0, batch, jax.random.key(0))
+    return dict(
+        cfg=cfg, tcfg=tcfg, model=model, tx=tx, batch=batch,
+        ref_params=jax.device_get(ref_state.params),
+        ref_loss=float(ref_metrics["loss"]),
+        ref_gnorm=float(ref_metrics["grad_norm"]),
+    )
+
+
+STRATEGIES = [
+    ("no_shard", 8, 1),
+    ("full_shard", 1, 8),
+    ("full_shard", 2, 4),
+    ("shard_grad_op", 1, 8),
+    ("shard_grad_op", 2, 4),
+]
+
+
+def _run_one(setup, strategy, data, fsdp, path):
+    cfg, tx, model = setup["cfg"], setup["tx"], setup["model"]
+    mcfg = MeshConfig(data=data, fsdp=fsdp, strategy=strategy)
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    if path == "explicit":
+        step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
+        bs = NamedSharding(mesh, batch_partition_spec(mcfg))
+        batch = {
+            k: jax.device_put(v, bs) for k, v in setup["batch"].items()
+        }
+    else:
+        step, put = make_parallel_train_step(model, cfg, tx, mesh, mcfg, state)
+        batch = put(setup["batch"])
+    new_state, metrics = step(state, batch, jax.random.key(0))
+    return new_state, metrics
+
+
+@pytest.mark.parametrize("strategy,data,fsdp", STRATEGIES)
+@pytest.mark.parametrize("path", ["auto", "explicit"])
+def test_parallel_matches_single_device(setup, strategy, data, fsdp, path):
+    new_state, metrics = _run_one(setup, strategy, data, fsdp, path)
+    assert float(metrics["loss"]) == pytest.approx(setup["ref_loss"], abs=1e-5)
+    assert float(metrics["grad_norm"]) == pytest.approx(
+        setup["ref_gnorm"], abs=1e-4
+    )
+    for a, b in zip(
+        jax.tree.leaves(setup["ref_params"]),
+        jax.tree.leaves(jax.device_get(new_state.params)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_full_shard_actually_shards_state(setup, eight_devices):
+    """ZeRO-3 contract: per-device param + opt bytes ~ 1/8 of total."""
+    cfg, tx, model = setup["cfg"], setup["tx"], setup["model"]
+    mcfg = MeshConfig(fsdp=8, strategy="full_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    # wte [128, 64]: sharded over rows -> each shard 16 rows.
+    wte = state.params["wte"]
+    shard_shapes = {
+        tuple(s.data.shape) for s in wte.addressable_shards
+    }
+    assert shard_shapes == {(16, 64)}
+    # Stacked block leaves never shard the layer dim.
+    specs = param_partition_specs(state.params, mcfg)
+    for spec in jax.tree.leaves(
+        specs["blocks"], is_leaf=lambda x: isinstance(x, P)
+    ):
+        assert not spec or spec[0] is None
+
+
+def test_shard_grad_op_replicates_params_shards_opt(setup, eight_devices):
+    cfg, tx, model = setup["cfg"], setup["tx"], setup["model"]
+    mcfg = MeshConfig(fsdp=8, strategy="shard_grad_op")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    # Params replicated: every shard is the full array.
+    wte = state.params["wte"]
+    assert {tuple(s.data.shape) for s in wte.addressable_shards} == {(128, 64)}
+    # Adam moments sharded.
+    mu_leaves = [
+        l for l in jax.tree.leaves(state.opt_state)
+        if hasattr(l, "addressable_shards") and l.ndim >= 2
+    ]
+    assert any(
+        {tuple(s.data.shape) for s in l.addressable_shards} != {tuple(l.shape)}
+        for l in mu_leaves
+    )
+
+
+def test_batch_partition_spec():
+    assert batch_partition_spec(MeshConfig(data=8)) == P(None, ("data",), None)
+    assert batch_partition_spec(
+        MeshConfig(data=2, fsdp=4)
+    ) == P(None, ("data", "fsdp"), None)
+    assert batch_partition_spec(MeshConfig()) == P(None, None, None)
+    assert data_parallel_size(MeshConfig(data=2, fsdp=4)) == 8
+
+
+def test_mesh_too_big_rejected(eight_devices):
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=16))
