@@ -1,0 +1,181 @@
+"""GPT-2 weight import/export: HF checkpoints and reference-style state dicts.
+
+Capability twin of reference model/my_gpt2.py:250-312:
+- ``save()``/``from_pretrained()`` — our framework-native equivalent is
+  train/checkpoint.py; this module covers the *interchange* formats;
+- ``from_hf_pretrained()`` with the Conv1D->Linear transpose
+  (``_convert_conv1d_to_linear_state_dict``, reference :254-280).
+
+Layout notes (why the transposes differ from the reference):
+- HF GPT-2 stores c_attn/c_proj/c_fc as Conv1D with weight [in, out].
+- torch nn.Linear stores [out, in] — hence the reference transposes.
+- Our dense kernels are [in, out] (ops/layers.py), so HF Conv1D weights
+  import WITHOUT transpose; torch-Linear-style dicts (produced by the
+  reference's ``save()``) need the transpose instead.
+
+Both importers accept a flat ``{name: array}`` mapping (torch tensors or
+numpy arrays; anything with ``numpy()`` or ``__array__``) so torch is an
+optional dependency. Stacking: per-layer HF arrays ``h.{i}.*`` are stacked
+along a new leading layer axis to match our scanned [L, ...] params.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import ModelConfig
+
+
+def _to_np(x) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+# HF GPT-2 parameter names (relative prefix; both bare and "transformer."-
+# prefixed checkpoints exist in the wild).
+_HF_BLOCK_KEYS = {
+    "ln_1.weight": ("ln_1", "scale"),
+    "ln_1.bias": ("ln_1", "bias"),
+    "attn.c_attn.weight": ("attn", "c_attn", "kernel"),
+    "attn.c_attn.bias": ("attn", "c_attn", "bias"),
+    "attn.c_proj.weight": ("attn", "c_proj", "kernel"),
+    "attn.c_proj.bias": ("attn", "c_proj", "bias"),
+    "ln_2.weight": ("ln_2", "scale"),
+    "ln_2.bias": ("ln_2", "bias"),
+    "mlp.c_fc.weight": ("mlp", "c_fc", "kernel"),
+    "mlp.c_fc.bias": ("mlp", "c_fc", "bias"),
+    "mlp.c_proj.weight": ("mlp", "c_proj", "kernel"),
+    "mlp.c_proj.bias": ("mlp", "c_proj", "bias"),
+}
+
+_CONV1D_KERNELS = {"attn.c_attn.weight", "attn.c_proj.weight", "mlp.c_fc.weight"}
+_ALL_KERNELS = _CONV1D_KERNELS | {"mlp.c_proj.weight"}
+
+
+def _strip_prefix(sd: dict) -> dict:
+    out = {}
+    for k, v in sd.items():
+        if k.startswith("transformer."):
+            k = k[len("transformer.") :]
+        out[k] = v
+    return out
+
+
+def _set_nested(tree: dict, path: tuple[str, ...], value) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def from_hf_gpt2_state_dict(sd: dict, cfg: ModelConfig) -> dict:
+    """Convert an HF GPT2LMHeadModel/GPT2Model state dict to our params.
+
+    HF Conv1D weights are [in, out] — identical to our kernel layout, so no
+    transpose is needed (the reference's transpose exists only because torch
+    Linear is [out, in], reference my_gpt2.py:254-280). ``lm_head.weight`` is
+    ignored: the head is tied to wte (reference :206).
+    """
+    return _import_state_dict(sd, cfg, kernels_transposed=False)
+
+
+def from_reference_state_dict(sd: dict, cfg: ModelConfig) -> dict:
+    """Convert a torch-Linear-layout state dict (what the reference model's
+    ``save()`` produces after its Conv1D->Linear conversion) to our params:
+    every linear weight is [out, in] and IS transposed here."""
+    return _import_state_dict(sd, cfg, kernels_transposed=True)
+
+
+def _import_state_dict(
+    sd: dict, cfg: ModelConfig, *, kernels_transposed: bool
+) -> dict:
+    sd = _strip_prefix({k: _to_np(v) for k, v in sd.items()})
+    dtype = np.dtype(cfg.param_dtype)
+
+    def kernel_fix(name: str, arr: np.ndarray) -> np.ndarray:
+        if name in _ALL_KERNELS and kernels_transposed:
+            return arr.T
+        return arr
+
+    params: dict = {
+        "wte": sd["wte.weight"].astype(dtype),
+        "wpe": sd["wpe.weight"].astype(dtype),
+        "ln_f": {
+            "scale": sd["ln_f.weight"].astype(dtype),
+            "bias": sd["ln_f.bias"].astype(dtype),
+        },
+        "blocks": {},
+    }
+    if params["wte"].shape != (cfg.vocab_size, cfg.n_embd):
+        raise ValueError(
+            f"wte shape {params['wte'].shape} != "
+            f"({cfg.vocab_size}, {cfg.n_embd})"
+        )
+
+    for hf_key, path in _HF_BLOCK_KEYS.items():
+        per_layer = []
+        for layer in range(cfg.n_layer):
+            name = f"h.{layer}.{hf_key}"
+            if name not in sd:
+                raise KeyError(f"missing {name!r} in state dict")
+            per_layer.append(kernel_fix(hf_key, sd[name]))
+        stacked = np.stack(per_layer).astype(dtype)
+        _set_nested(params["blocks"], path, stacked)
+
+    expect_qkv = (cfg.n_layer, cfg.n_embd, 3 * cfg.n_embd)
+    got = params["blocks"]["attn"]["c_attn"]["kernel"].shape
+    if got != expect_qkv:
+        raise ValueError(
+            f"c_attn kernel stacked shape {got} != {expect_qkv} — wrong "
+            "layout? (use from_reference_state_dict for torch-Linear dicts)"
+        )
+    return params
+
+
+def to_hf_gpt2_state_dict(params: dict) -> dict:
+    """Export our params to HF GPT-2 (Conv1D-layout) naming — the inverse of
+    ``from_hf_gpt2_state_dict``; includes the tied ``lm_head.weight``."""
+    out = {
+        "wte.weight": np.asarray(params["wte"]),
+        "wpe.weight": np.asarray(params["wpe"]),
+        "ln_f.weight": np.asarray(params["ln_f"]["scale"]),
+        "ln_f.bias": np.asarray(params["ln_f"]["bias"]),
+        "lm_head.weight": np.asarray(params["wte"]),
+    }
+    blocks = params["blocks"]
+    n_layer = np.asarray(blocks["ln_1"]["scale"]).shape[0]
+
+    def get(path):
+        node = blocks
+        for p in path:
+            node = node[p]
+        return np.asarray(node)
+
+    for hf_key, path in _HF_BLOCK_KEYS.items():
+        stacked = get(path)
+        for layer in range(n_layer):
+            out[f"h.{layer}.{hf_key}"] = stacked[layer]
+    return out
+
+
+def from_hf_pretrained(model_name: str = "gpt2", cfg: ModelConfig | None = None):
+    """Download HF GPT-2 weights and convert (reference
+    from_hf_pretrained, my_gpt2.py:292-306). Needs network + transformers;
+    in zero-egress environments convert a local state dict via
+    ``from_hf_gpt2_state_dict`` instead."""
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    from pytorch_distributed_tpu.config import model_config
+
+    if cfg is None:
+        hf_cfg = AutoConfig.from_pretrained(model_name)
+        cfg = model_config("gpt2").replace(
+            vocab_size=hf_cfg.vocab_size,
+            n_ctx=hf_cfg.n_positions,
+            n_embd=hf_cfg.n_embd,
+            n_layer=hf_cfg.n_layer,
+            n_head=hf_cfg.n_head,
+        )
+    model = AutoModelForCausalLM.from_pretrained(model_name)
+    return from_hf_gpt2_state_dict(model.state_dict(), cfg), cfg

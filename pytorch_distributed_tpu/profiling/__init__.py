@@ -1,0 +1,14 @@
+from pytorch_distributed_tpu.profiling.profiler import (  # noqa: F401
+    ScheduledProfiler,
+    find_trace_files,
+)
+from pytorch_distributed_tpu.profiling.memory import (  # noqa: F401
+    analytic_memory_breakdown,
+    measured_memory,
+    save_memory_snapshot,
+)
+from pytorch_distributed_tpu.profiling.throughput import (  # noqa: F401
+    compare_batch_sizes,
+    extrapolate_modern_training,
+    measure_tokens_per_second,
+)

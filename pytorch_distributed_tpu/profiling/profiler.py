@@ -1,0 +1,111 @@
+"""Scheduled profiler tracing.
+
+Capability twin of the reference's torch.profiler setup
+(reference train_baseline.py:79-87): a step-counting schedule
+(wait=2, warmup=2, active=6, repeat=1), per-rank trace outputs
+(reference train_ddp.py:131-139 writes rank{r}_trace.json; here each process
+writes its own trace dir), and per-step annotations
+(reference train/trainer.py:111-113 steps the profiler; our Trainer calls
+``profiler.step()`` once per optimizer step and wraps the step in
+``profiler.step_context(n)``).
+
+TPU-native: ``jax.profiler.start_trace/stop_trace`` produce XPlane protos
+plus a Chrome-trace JSON (``*.trace.json.gz``) with device-side "XLA Ops" /
+"Async XLA Ops" tracks — consumed by profiling/trace_analysis.py (the HTA
+analogue). There is no CUPTI warmup on TPU, so "warmup" steps simply extend
+the wait window; the active window covers the same step indices as the
+reference's schedule (steps wait+warmup .. wait+warmup+active-1).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import os
+from pathlib import Path
+
+import jax
+
+
+class ScheduledProfiler:
+    def __init__(
+        self,
+        trace_dir: str | Path,
+        *,
+        wait: int = 2,
+        warmup: int = 2,
+        active: int = 6,
+        repeat: int = 1,
+        create_perfetto_trace: bool = True,
+    ):
+        if active <= 0:
+            raise ValueError("active must be positive")
+        self.trace_dir = str(
+            Path(trace_dir) / f"rank{jax.process_index()}"
+        )
+        self.wait = wait
+        self.warmup = warmup
+        self.active = active
+        self.repeat = repeat  # 0 = cycle forever, like torch.profiler
+        self._perfetto = create_perfetto_trace
+        self._count = 0
+        self._cycles_done = 0
+        self._tracing = False
+
+    # -- schedule ---------------------------------------------------------
+    def _phase(self) -> str:
+        cycle_len = self.wait + self.warmup + self.active
+        if self.repeat and self._cycles_done >= self.repeat:
+            return "done"
+        pos = self._count % cycle_len
+        if pos < self.wait + self.warmup:
+            return "wait"
+        return "active"
+
+    def step(self) -> None:
+        """Advance the schedule by one (optimizer) step. Must be called
+        exactly once per step, after the step runs (reference trainer.py
+        calls profiler.step() at the end of each micro-batch; see
+        train/trainer.py for why ours counts optimizer steps)."""
+        self._count += 1
+        phase = self._phase()
+        if phase == "active" and not self._tracing:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(
+                self.trace_dir,
+                create_perfetto_trace=self._perfetto,
+            )
+            self._tracing = True
+        elif phase != "active" and self._tracing:
+            self._stop()
+            self._cycles_done += 1
+
+    def _stop(self) -> None:
+        if self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
+
+    def step_context(self, step_num: int):
+        """Context manager annotating one train step in the trace."""
+        if self._tracing or self._phase() == "active":
+            return jax.profiler.StepTraceAnnotation(
+                "train_step", step_num=step_num
+            )
+        return contextlib.nullcontext()
+
+    def close(self) -> None:
+        self._stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def find_trace_files(trace_dir: str | Path, pattern: str = "*.trace.json.gz"):
+    """Locate Chrome-trace JSONs under a (possibly per-rank) trace dir."""
+    return sorted(
+        glob.glob(str(Path(trace_dir) / "**" / pattern), recursive=True)
+    )

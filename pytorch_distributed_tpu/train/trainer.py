@@ -21,6 +21,7 @@ responsibilities, TPU-native shape:
 
 from __future__ import annotations
 
+import contextlib
 import time
 from pathlib import Path
 from typing import Any, Callable, Iterable
@@ -218,9 +219,15 @@ class Trainer:
             if step >= num_steps:
                 break
             dkey = step_key(self._dropout_root, step)
-            state, metrics = self.train_step(
-                state, self._put_batch(batch), dkey
+            ctx = (
+                profiler.step_context(step)
+                if profiler is not None and hasattr(profiler, "step_context")
+                else contextlib.nullcontext()
             )
+            with ctx:
+                state, metrics = self.train_step(
+                    state, self._put_batch(batch), dkey
+                )
 
             loss = float(jax.device_get(metrics["loss"]))
             window_losses.append(loss)

@@ -1,0 +1,135 @@
+"""Shared CLI plumbing for entry scripts.
+
+The reference hardcodes hyperparameters per script
+(reference train_baseline.py:24-31: GPT-2 Large, global 32, micro 8, T=1024,
+20 steps, AdamW lr 3e-4 wd 0.1, cosine->0.1lr) with one argparse flag.
+These scripts keep those defaults but expose them as flags, plus:
+
+--data synthetic|fineweb   zero-egress default is synthetic shards in kjj0
+                           format; fineweb downloads like reference
+                           data_loader.py:9-65.
+--preset / model flags     AutoConfig replacement (config.model_config).
+--cpu-devices N            run on N virtual CPU devices — the cluster-free
+                           way to exercise multi-device paths
+                           (SURVEY.md §4; must be set before jax imports,
+                           which is why scripts parse args first and import
+                           jax after).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
+    p.add_argument("--preset", default=preset,
+                   help="model preset (gpt2, gpt2-large, gpt2-1p3b, "
+                        "llama3-1b, ... or 'tiny')")
+    p.add_argument("--data", default="synthetic",
+                   choices=["synthetic", "fineweb"])
+    p.add_argument("--data-dir", default=".cache/data")
+    p.add_argument("--num-train-files", type=int, default=10)
+    p.add_argument("--global-batch-size", type=int, default=32)
+    p.add_argument("--micro-batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--lr", type=float, default=3e-4)
+    p.add_argument("--weight-decay", type=float, default=0.1)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--log-every", type=int, default=10)
+    p.add_argument("--save-every", type=int, default=None)
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from latest checkpoint (capability the "
+                        "reference has at trainer level but never wires up)")
+    p.add_argument("--dtype", default=None,
+                   help="activation dtype override (bfloat16/float32)")
+    p.add_argument("--no-profiler", action="store_true")
+    p.add_argument("--trace-dir", default=None)
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="force CPU platform with this many virtual devices")
+
+
+def setup_platform(args) -> None:
+    """MUST run before any jax import."""
+    if args.cpu_devices:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.cpu_devices}"
+        ).strip()
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def build_model_cfg(args):
+    from pytorch_distributed_tpu.config import ModelConfig, model_config
+
+    if args.preset == "tiny":
+        cfg = ModelConfig(
+            vocab_size=256, n_ctx=max(args.seq_len, 32), n_embd=64,
+            n_layer=2, n_head=4, dtype="float32",
+        )
+    else:
+        cfg = model_config(args.preset)
+    if args.dtype:
+        cfg = cfg.replace(dtype=args.dtype)
+    if args.seq_len > cfg.n_ctx:
+        raise SystemExit(
+            f"--seq-len {args.seq_len} exceeds model n_ctx {cfg.n_ctx}"
+        )
+    return cfg
+
+
+def build_train_cfg(args, *, data_parallel_size: int = 1):
+    from pytorch_distributed_tpu.config import TrainConfig
+
+    cfg = TrainConfig(
+        global_batch_size=args.global_batch_size,
+        micro_batch_size=args.micro_batch_size,
+        num_steps=args.steps,
+        learning_rate=args.lr,
+        weight_decay=args.weight_decay,
+        seed=args.seed,
+        log_every_n_steps=args.log_every,
+        save_every_n_steps=args.save_every,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    cfg.grad_accum_steps(data_parallel_size)  # validate divisibility early
+    return cfg
+
+
+def shard_paths(args, vocab_size: int) -> list[str]:
+    if args.data == "fineweb":
+        from pytorch_distributed_tpu.data.download import (
+            download_fineweb10B_files,
+        )
+
+        return download_fineweb10B_files(
+            os.path.join(args.data_dir, "fineweb10B"),
+            num_train_files=args.num_train_files,
+        )
+    from pytorch_distributed_tpu.data.synthetic import make_synthetic_shards
+
+    return make_synthetic_shards(
+        os.path.join(args.data_dir, "synthetic"),
+        num_shards=max(2, args.num_train_files),
+        tokens_per_shard=2_000_000,
+        vocab_size=min(vocab_size, 2**16),
+        seed=args.seed,
+    )
+
+
+def make_profiler(args, default_trace_dir: str):
+    if args.no_profiler:
+        return None
+    from pytorch_distributed_tpu.profiling.profiler import ScheduledProfiler
+
+    # Reference schedule: wait=2, warmup=2, active=6, repeat=1
+    # (train_baseline.py:83-86).
+    return ScheduledProfiler(
+        args.trace_dir or default_trace_dir,
+        wait=2, warmup=2, active=6, repeat=1,
+    )

@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Trace analysis report: temporal breakdown, comm/comp overlap, op diffs.
+
+Capability twin of reference assignments/assignment1/analyze_traces.ipynb
+(the HTA notebook): for each trace dir produced by the training scripts it
+prints (a) the compute/communication/idle temporal breakdown, (b) the
+communication hidden-vs-exposed overlap, and for each requested pair
+(c) the operator diff filtered to collectives — the notebook's
+baseline<->DDP, DDP<->FSDP comparisons.
+
+Examples:
+  python scripts/analyze_traces.py outputs/traces/baseline outputs/traces/ddp
+  python scripts/analyze_traces.py outputs/traces/ddp outputs/traces/fsdp_full_shard --all-ops
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+
+def _latest_trace(d: str) -> str | None:
+    from pytorch_distributed_tpu.profiling.profiler import find_trace_files
+
+    files = find_trace_files(d)
+    return files[-1] if files else None
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace_dirs", nargs="+",
+                   help="trace dirs (or .trace.json.gz files)")
+    p.add_argument("--all-ops", action="store_true",
+                   help="diff all ops, not just collectives")
+    p.add_argument("--top", type=int, default=15)
+    args = p.parse_args()
+
+    from pytorch_distributed_tpu.profiling.trace_analysis import (
+        comm_comp_overlap,
+        load_trace,
+        ops_diff,
+        temporal_breakdown,
+    )
+
+    traces = {}
+    for d in args.trace_dirs:
+        path = d if d.endswith(".json.gz") else _latest_trace(d)
+        if path is None:
+            print(f"!! no trace files under {d}", file=sys.stderr)
+            continue
+        traces[d] = load_trace(path)
+        print(f"== {d} ({Path(path).name}) ==")
+        tb = temporal_breakdown(traces[d])
+        if tb["total_us"] == 0:
+            print("  (no device-op track in this trace — CPU runs record "
+                  "host-side events only; run on TPU for device analysis)")
+        print(
+            f"  temporal: compute {tb['compute_pct']:.1f}% | "
+            f"comm {tb['communication_pct']:.1f}% "
+            f"(exposed {tb['communication_exposed_pct']:.1f}%) | "
+            f"memcpy {tb['memcpy_pct']:.1f}% | idle {tb['idle_pct']:.1f}%"
+        )
+        ov = comm_comp_overlap(traces[d])
+        print(
+            f"  overlap: comm {ov['comm_total_us']:.0f}us, "
+            f"hidden {ov['overlap_pct']:.1f}%, "
+            f"exposed {ov['exposed_pct']:.1f}%"
+        )
+
+    names = list(traces)
+    for i in range(len(names) - 1):
+        a, b = names[i], names[i + 1]
+        cats = None if args.all_ops else {"communication"}
+        diff = ops_diff(traces[a], traces[b], only_categories=cats,
+                        top=args.top)
+        label = "all ops" if args.all_ops else "collectives"
+        print(f"\n== op diff ({label}): {a} -> {b} ==")
+        for name, rec in diff["added"].items():
+            print(f"  + {name}: {rec['count']}x, {rec['total_us']:.0f}us")
+        for name, rec in diff["removed"].items():
+            print(f"  - {name}: {rec['count']}x, {rec['total_us']:.0f}us")
+        for name, rec in diff["changed"].items():
+            print(
+                f"  ~ {name}: {rec['count_a']}x->{rec['count_b']}x, "
+                f"{rec['delta_us']:+.0f}us"
+            )
+        if not any(diff.values()):
+            print("  (no differences)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

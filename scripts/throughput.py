@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Throughput measurement, scaling extrapolation, and batch-size sweep.
+
+Capability twin of reference assignments/assignment0/throughput.py:
+tokens/sec + steps/sec on dummy data (reference :13-83), extrapolation to
+1T params / 10T tokens (reference :86-129), and an OOM-tolerant batch sweep
+(reference :132-181).
+
+Example:
+  python scripts/throughput.py --preset tiny --seq-len 64 \\
+      --micro-batch-size 4 --steps 5 --cpu-devices 1 --sweep 1,2
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import add_common_args, build_model_cfg, setup_platform  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_common_args(p, preset="gpt2")
+    p.add_argument("--warmup-steps", type=int, default=5)
+    p.add_argument("--sweep", default="1,4,8,16,32,64",
+                   help="comma-separated batch sizes ('' disables)")
+    p.add_argument("--no-extrapolate", action="store_true")
+    args = p.parse_args()
+    setup_platform(args)
+
+    from pytorch_distributed_tpu.profiling.throughput import (
+        compare_batch_sizes,
+        extrapolate_modern_training,
+        measure_tokens_per_second,
+    )
+
+    cfg = build_model_cfg(args)
+    b, t = args.micro_batch_size, args.seq_len
+
+    print(f"=== throughput: {args.preset}, B={b}, T={t}, "
+          f"{args.warmup_steps} warmup + {args.steps} timed ===")
+    r = measure_tokens_per_second(
+        cfg, batch_size=b, seq_len=t, num_steps=args.steps,
+        warmup_steps=args.warmup_steps,
+    )
+    print(f"tokens/sec: {r['tokens_per_second']:,.0f}")
+    print(f"steps/sec:  {r['steps_per_second']:.3f}")
+    print(f"sec/step:   {r['seconds_per_step'] * 1000:.1f} ms")
+    print(f"params:     {r['param_count']:,}")
+
+    if not args.no_extrapolate:
+        ex = extrapolate_modern_training(r)
+        print("\n=== extrapolation to 1T params / 10T tokens "
+              "(reference throughput.py:86-129) ===")
+        print(f"scaled tokens/sec: {ex['scaled_tokens_per_second']:.2f}")
+        print(f"time: {ex['days']:,.0f} days = {ex['years']:,.1f} years")
+        print(f"(assumption: {ex['assumption']})")
+
+    if args.sweep:
+        sizes = tuple(int(x) for x in args.sweep.split(","))
+        print(f"\n=== batch-size sweep {sizes} "
+              "(reference throughput.py:132-181) ===")
+        rows = compare_batch_sizes(
+            cfg, batch_sizes=sizes, seq_len=t,
+            num_steps=max(2, args.steps // 2),
+            warmup_steps=min(2, args.warmup_steps),
+        )
+        print(f"{'batch':>6} {'tokens/s':>12} {'peak mem':>12}")
+        for row in rows:
+            if row.get("oom"):
+                print(f"{row['batch_size']:>6} {'OOM':>12}")
+            else:
+                peak = row.get("peak_bytes_in_use", 0)
+                print(
+                    f"{row['batch_size']:>6} "
+                    f"{row['tokens_per_second']:>12,.0f} "
+                    f"{peak / 2**20:>10.0f}Mi"
+                )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Single-device training baseline.
+
+Capability twin of reference assignments/assignment1/train_baseline.py:
+GPT-2 Large by default, global batch 32 / micro 8 / T=1024 / 20 steps,
+AdamW lr 3e-4 wd 0.1, cosine anneal to 0.1*lr, profiler schedule
+wait=2/warmup=2/active=6 writing Chrome traces to outputs/traces/baseline.
+
+Examples:
+  python scripts/train_baseline.py --preset tiny --seq-len 64 \\
+      --global-batch-size 8 --micro-batch-size 4 --steps 8 --cpu-devices 1
+  python scripts/train_baseline.py          # gpt2-large on the TPU chip
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import (  # noqa: E402
+    add_common_args,
+    build_model_cfg,
+    build_train_cfg,
+    make_profiler,
+    setup_platform,
+    shard_paths,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_common_args(p, preset="gpt2-large")
+    args = p.parse_args()
+    setup_platform(args)
+
+    from pytorch_distributed_tpu.data import TokenShardLoader
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.train import Trainer
+    from pytorch_distributed_tpu.utils.logging import get_logger
+
+    log = get_logger("pdtpu.baseline")
+    model_cfg = build_model_cfg(args)
+    train_cfg = build_train_cfg(args)
+    model = get_model(model_cfg)
+
+    paths = shard_paths(args, model_cfg.vocab_size)
+    loader = TokenShardLoader(
+        paths, args.micro_batch_size, args.seq_len
+    )
+    log.info(
+        f"model={args.preset} data={args.data} shards={len(paths)} "
+        f"accum={train_cfg.grad_accum_steps()}"
+    )
+
+    trainer = Trainer(model, model_cfg, train_cfg)
+    state = trainer.init_state()
+    if args.resume:
+        state = trainer.resume_latest(state)
+
+    profiler = make_profiler(args, "outputs/traces/baseline")
+    try:
+        state, history = trainer.train(
+            loader, state=state, profiler=profiler
+        )
+    finally:
+        if profiler is not None:
+            profiler.close()
+    final = history[-1] if history else {}
+    log.info(f"done: {final}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

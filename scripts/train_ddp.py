@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Data-parallel (DDP-equivalent) training.
+
+Capability twin of reference assignments/assignment1/train_ddp.py: replicated
+params, batch sharded over a 1-D data mesh, ONE gradient all-reduce per
+optimizer step at the accumulation boundary (the torchrun + NCCL + DDP
+reducer stack collapses into mesh + psum — SURVEY.md §2.3). Per-process
+traces go to outputs/traces/ddp/rank{r}.
+
+--path explicit writes the collectives by hand (shard_map + lax.pmean) so
+they are visible in the trace, mirroring what DDP's reducer does; --path auto
+lets XLA place them.
+
+Examples:
+  python scripts/train_ddp.py --preset tiny --seq-len 64 --cpu-devices 8 \\
+      --global-batch-size 16 --micro-batch-size 1 --steps 8
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import (  # noqa: E402
+    add_common_args,
+    build_model_cfg,
+    build_train_cfg,
+    make_profiler,
+    setup_platform,
+    shard_paths,
+)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_common_args(p, preset="gpt2-large")
+    p.add_argument("--path", default="auto", choices=["auto", "explicit"])
+    args = p.parse_args()
+    setup_platform(args)
+
+    import jax
+
+    from pytorch_distributed_tpu.config import MeshConfig
+    from pytorch_distributed_tpu.data import DistributedTokenShardLoader
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.mesh import initialize_distributed
+    from pytorch_distributed_tpu.train.distributed_trainer import (
+        DistributedTrainer,
+    )
+    from pytorch_distributed_tpu.utils.logging import get_logger
+
+    initialize_distributed()
+    log = get_logger("pdtpu.ddp")
+    n_devices = len(jax.devices())
+    mesh_cfg = MeshConfig(data=n_devices, strategy="no_shard")
+    mesh = make_mesh(mesh_cfg)
+
+    model_cfg = build_model_cfg(args)
+    train_cfg = build_train_cfg(args, data_parallel_size=n_devices)
+    model = get_model(model_cfg)
+
+    paths = shard_paths(args, model_cfg.vocab_size)
+    # Each process feeds its slice of the global stream; with one process the
+    # slice IS the global micro-batch (micro * world rows).
+    local_rows = args.micro_batch_size * (n_devices // jax.process_count())
+    loader = DistributedTokenShardLoader(
+        paths,
+        local_rows,
+        args.seq_len,
+        rank=jax.process_index(),
+        world_size=jax.process_count(),
+    )
+    log.info(
+        f"DDP over {n_devices} devices ({jax.process_count()} processes), "
+        f"accum={train_cfg.grad_accum_steps(n_devices)}, path={args.path}"
+    )
+
+    trainer = DistributedTrainer(
+        model, model_cfg, train_cfg, mesh, mesh_cfg, path=args.path
+    )
+    profiler = make_profiler(args, "outputs/traces/ddp")
+    try:
+        state, history = trainer.train(loader, profiler=profiler)
+    finally:
+        if profiler is not None:
+            profiler.close()
+    log.info(f"done: {history[-1] if history else {}}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

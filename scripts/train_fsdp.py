@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Fully-sharded data-parallel (FSDP/ZeRO-equivalent) training.
+
+Capability twin of reference assignments/assignment1/train_fsdp.py with its
+--strategy flag (reference :88-92) and strategy semantics (reference :49-59):
+
+  FULL_SHARD      params+grads+optimizer sharded (all_gather params per
+                  layer, reduce_scatter grads) — ZeRO-3
+  SHARD_GRAD_OP   grads+optimizer sharded, params replicated — ZeRO-2
+  NO_SHARD        DDP-equivalent comparison arm
+
+The reference wraps each transformer block in an FSDP unit (reference
+:71-81); here per-block granularity falls out of scan-over-layers + remat
+with stacked [L, ...] sharded params. Traces: outputs/traces/fsdp_{strategy}.
+
+Examples:
+  python scripts/train_fsdp.py --preset tiny --seq-len 64 --cpu-devices 8 \\
+      --strategy FULL_SHARD --global-batch-size 16 --micro-batch-size 1 --steps 8
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import (  # noqa: E402
+    add_common_args,
+    build_model_cfg,
+    build_train_cfg,
+    make_profiler,
+    setup_platform,
+    shard_paths,
+)
+
+_STRATEGY_MAP = {
+    "FULL_SHARD": "full_shard",
+    "SHARD_GRAD_OP": "shard_grad_op",
+    "NO_SHARD": "no_shard",
+    "full_shard": "full_shard",
+    "shard_grad_op": "shard_grad_op",
+    "no_shard": "no_shard",
+}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_common_args(p, preset="gpt2-large")
+    p.add_argument(
+        "--strategy",
+        default="FULL_SHARD",
+        choices=sorted(_STRATEGY_MAP),
+        help="FSDP sharding strategy (reference train_fsdp.py:88-92)",
+    )
+    p.add_argument("--path", default="auto", choices=["auto", "explicit"])
+    args = p.parse_args()
+    setup_platform(args)
+
+    import jax
+
+    from pytorch_distributed_tpu.config import MeshConfig
+    from pytorch_distributed_tpu.data import DistributedTokenShardLoader
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.mesh import initialize_distributed
+    from pytorch_distributed_tpu.train.distributed_trainer import (
+        DistributedTrainer,
+    )
+    from pytorch_distributed_tpu.utils.logging import get_logger
+
+    initialize_distributed()
+    log = get_logger("pdtpu.fsdp")
+    strategy = _STRATEGY_MAP[args.strategy]
+    n_devices = len(jax.devices())
+    mesh_cfg = MeshConfig(fsdp=n_devices, strategy=strategy)
+    mesh = make_mesh(mesh_cfg)
+
+    model_cfg = build_model_cfg(args)
+    train_cfg = build_train_cfg(args, data_parallel_size=n_devices)
+    model = get_model(model_cfg)
+
+    paths = shard_paths(args, model_cfg.vocab_size)
+    local_rows = args.micro_batch_size * (n_devices // jax.process_count())
+    loader = DistributedTokenShardLoader(
+        paths,
+        local_rows,
+        args.seq_len,
+        rank=jax.process_index(),
+        world_size=jax.process_count(),
+    )
+    log.info(
+        f"FSDP {strategy} over {n_devices} devices, "
+        f"accum={train_cfg.grad_accum_steps(n_devices)}, path={args.path}"
+    )
+
+    trainer = DistributedTrainer(
+        model, model_cfg, train_cfg, mesh, mesh_cfg, path=args.path
+    )
+    profiler = make_profiler(args, f"outputs/traces/fsdp_{strategy}")
+    try:
+        state, history = trainer.train(loader, profiler=profiler)
+    finally:
+        if profiler is not None:
+            profiler.close()
+    log.info(f"done: {history[-1] if history else {}}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

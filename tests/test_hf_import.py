@@ -1,0 +1,116 @@
+"""Golden parity vs a real HF GPT-2 (random-init, no network): the strongest
+model-correctness test we can run in a zero-egress environment — logits must
+match transformers' GPT2LMHeadModel to float tolerance (SURVEY.md §4:
+'model-forward golden tests vs HF GPT-2')."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from pytorch_distributed_tpu.config import ModelConfig
+from pytorch_distributed_tpu.models import gpt2
+from pytorch_distributed_tpu.models.hf_import import (
+    from_hf_gpt2_state_dict,
+    from_reference_state_dict,
+    to_hf_gpt2_state_dict,
+)
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def hf_model_and_cfg():
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=211,
+        n_positions=32,
+        n_embd=48,
+        n_layer=3,
+        n_head=4,
+        resid_pdrop=0.0,
+        embd_pdrop=0.0,
+        attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = ModelConfig(
+        vocab_size=211, n_ctx=32, n_embd=48, n_layer=3, n_head=4,
+        dtype="float32", embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+    )
+    return model, cfg
+
+
+def test_logits_match_hf_gpt2(hf_model_and_cfg):
+    model, cfg = hf_model_and_cfg
+    params = from_hf_gpt2_state_dict(model.state_dict(), cfg)
+    ids = np.random.default_rng(1).integers(0, 211, (2, 32))
+    with torch.no_grad():
+        ref = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(gpt2.apply(params, jax.numpy.asarray(ids), cfg))
+    np.testing.assert_allclose(got, ref, atol=2e-4)
+
+
+def test_flash_attention_matches_hf_gpt2(hf_model_and_cfg):
+    model, cfg = hf_model_and_cfg
+    cfg = cfg.replace(attention_impl="flash")
+    params = from_hf_gpt2_state_dict(model.state_dict(), cfg)
+    ids = np.random.default_rng(2).integers(0, 211, (1, 32))
+    with torch.no_grad():
+        ref = model(torch.from_numpy(ids)).logits.numpy()
+    got = np.asarray(gpt2.apply(params, jax.numpy.asarray(ids), cfg))
+    np.testing.assert_allclose(got, ref, atol=5e-4)
+
+
+def test_reference_linear_layout_roundtrip(hf_model_and_cfg):
+    """A torch-Linear-layout dict (Conv1D transposed, as the reference's
+    converter produces) imports to the same params as the HF dict."""
+    model, cfg = hf_model_and_cfg
+    sd = model.state_dict()
+    linear_sd = {}
+    conv1d = {"attn.c_attn.weight", "attn.c_proj.weight", "mlp.c_fc.weight",
+              "mlp.c_proj.weight"}
+    for k, v in sd.items():
+        base = (
+            ".".join(k.split(".")[3:])
+            if k.startswith("transformer.h.")
+            else None
+        )
+        if base in conv1d:
+            linear_sd[k] = v.T.contiguous()
+        else:
+            linear_sd[k] = v
+    a = from_hf_gpt2_state_dict(sd, cfg)
+    b = from_reference_state_dict(linear_sd, cfg)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_export_roundtrip(hf_model_and_cfg):
+    model, cfg = hf_model_and_cfg
+    params = from_hf_gpt2_state_dict(model.state_dict(), cfg)
+    exported = to_hf_gpt2_state_dict(params)
+    reimported = from_hf_gpt2_state_dict(exported, cfg)
+    for x, y in zip(jax.tree.leaves(params), jax.tree.leaves(reimported)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert "lm_head.weight" in exported
+
+
+def test_missing_key_rejected(hf_model_and_cfg):
+    model, cfg = hf_model_and_cfg
+    sd = dict(model.state_dict())
+    sd.pop("transformer.h.1.mlp.c_fc.weight")
+    with pytest.raises(KeyError):
+        from_hf_gpt2_state_dict(sd, cfg)
+
+
+def test_wrong_layout_detected(hf_model_and_cfg):
+    """Feeding a Linear-layout dict to the Conv1D importer trips the shape
+    guard instead of silently mis-importing."""
+    model, cfg = hf_model_and_cfg
+    sd = {
+        k: (v.T.contiguous() if k.endswith("attn.c_attn.weight") else v)
+        for k, v in model.state_dict().items()
+    }
+    with pytest.raises(ValueError):
+        from_hf_gpt2_state_dict(sd, cfg)
