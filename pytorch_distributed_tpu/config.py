@@ -70,8 +70,8 @@ class ModelConfig:
             )
         if self.family not in ("gpt2", "llama"):
             raise ValueError(f"unknown model family: {self.family!r}")
-        # Keep in sync with ops/attention.py dispatch ("ring" joins once
-        # ops/ring_attention.py lands).
+        # Ring attention is selected by the parallelism layer (seq_axis in
+        # ops/attention.py), not by this per-model switch.
         if self.attention_impl not in ("naive", "flash"):
             raise ValueError(
                 f"unknown attention_impl: {self.attention_impl!r} "

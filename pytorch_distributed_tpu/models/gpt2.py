@@ -95,6 +95,7 @@ def _block(
     cfg: ModelConfig,
     layer_key: jax.Array | None,
     deterministic: bool,
+    seq_axis: str | None = None,
 ) -> jax.Array:
     """Pre-norm residual block (reference my_gpt2.py:121-134):
     x + attn(ln_1(x)); x + mlp(ln_2(x))."""
@@ -121,6 +122,7 @@ def _block(
         dropout_rate=cfg.attn_pdrop,
         dropout_key=k_attn,
         deterministic=deterministic,
+        seq_axis=seq_axis,
     ).reshape(b, t, e)
     a = dense(a, bp["attn"]["c_proj"])
     a = dropout(a, cfg.resid_pdrop, k_resid1, deterministic=deterministic)
@@ -143,6 +145,7 @@ def apply(
     deterministic: bool = True,
     dropout_key: jax.Array | None = None,
     block_transform=None,
+    seq_axis: str | None = None,
 ) -> jax.Array:
     """Forward pass: [B, T] token ids -> [B, T, V] float32 logits.
 
@@ -153,15 +156,31 @@ def apply(
     before use inside the scan — the hook explicit FSDP uses for just-in-time
     per-layer all_gather (parallel/explicit.py); remat then re-gathers in
     backward, matching FSDP's free-after-use behavior.
+
+    ``seq_axis``: set when called inside shard_map with the sequence dim
+    sharded over that mesh axis (context parallelism): positions are offset
+    by this shard's global start and attention runs the ring kernel.
     """
     if not deterministic and dropout_key is None:
         raise ValueError("training-mode apply() requires dropout_key")
     b, t = input_ids.shape
-    if t > cfg.n_ctx:
-        raise ValueError(f"sequence length {t} exceeds n_ctx {cfg.n_ctx}")
+    # Under sequence sharding the GLOBAL length (shards × local t) must fit
+    # the position table — dynamic_slice would silently clamp past-the-end
+    # shards onto the last wpe rows otherwise.
+    global_t = t * (jax.lax.psum(1, seq_axis) if seq_axis is not None else 1)
+    if global_t > cfg.n_ctx:
+        raise ValueError(
+            f"sequence length {global_t} exceeds n_ctx {cfg.n_ctx}"
+        )
     dtype = jnp.dtype(cfg.dtype)
 
-    x = params["wte"][input_ids] + params["wpe"][:t]
+    if seq_axis is not None:
+        # Local shard covers global positions [idx*t, (idx+1)*t).
+        pos_start = jax.lax.axis_index(seq_axis) * t
+        wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos_start, t, axis=0)
+    else:
+        wpe = params["wpe"][:t]
+    x = params["wte"][input_ids] + wpe
     x = x.astype(dtype)
     if not deterministic:
         dropout_key, k_embd = jax.random.split(dropout_key)
@@ -179,7 +198,7 @@ def apply(
             else jax.random.fold_in(dropout_key, layer_idx)
         )
         return (
-            _block(carry, bp, cfg, layer_key, deterministic),
+            _block(carry, bp, cfg, layer_key, deterministic, seq_axis),
             None,
         )
 

@@ -49,8 +49,15 @@ _HF_BLOCK_KEYS = {
     "mlp.c_proj.bias": ("mlp", "c_proj", "bias"),
 }
 
-_CONV1D_KERNELS = {"attn.c_attn.weight", "attn.c_proj.weight", "mlp.c_fc.weight"}
-_ALL_KERNELS = _CONV1D_KERNELS | {"mlp.c_proj.weight"}
+# Every one of these is an HF Conv1D [in, out] kernel — our dense kernels use
+# the same [in, out] layout, so they import transpose-free (unlike the
+# reference, which transposes for nn.Linear, my_gpt2.py:254-280).
+_KERNELS = {
+    "attn.c_attn.weight",
+    "attn.c_proj.weight",
+    "mlp.c_fc.weight",
+    "mlp.c_proj.weight",
+}
 
 
 def _strip_prefix(sd: dict) -> dict:
@@ -94,7 +101,7 @@ def _import_state_dict(
     dtype = np.dtype(cfg.param_dtype)
 
     def kernel_fix(name: str, arr: np.ndarray) -> np.ndarray:
-        if name in _ALL_KERNELS and kernels_transposed:
+        if name in _KERNELS and kernels_transposed:
             return arr.T
         return arr
 

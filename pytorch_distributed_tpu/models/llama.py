@@ -67,7 +67,7 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     }
 
 
-def _block(x, bp, cfg: ModelConfig, cos, sin):
+def _block(x, bp, cfg: ModelConfig, cos, sin, seq_axis=None):
     eps = cfg.layer_norm_epsilon
     b, t, e = x.shape
     h, kv, d = cfg.n_head, cfg.kv_heads, cfg.head_dim
@@ -79,7 +79,8 @@ def _block(x, bp, cfg: ModelConfig, cos, sin):
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     a = multi_head_attention(
-        q, k, v, impl=cfg.attention_impl, causal=True, deterministic=True
+        q, k, v, impl=cfg.attention_impl, causal=True, deterministic=True,
+        seq_axis=seq_axis,
     ).reshape(b, t, h * d)
     x = x + a @ bp["attn"]["wo"].astype(a.dtype)
 
@@ -98,23 +99,34 @@ def apply(
     deterministic: bool = True,
     dropout_key: jax.Array | None = None,
     block_transform=None,
+    seq_axis: str | None = None,
 ) -> jax.Array:
     """[B, T] int tokens -> [B, T, V] float32 logits. The llama family is
     dropout-free (cfg presets zero the pdrop fields), so train and eval
-    forward passes coincide. ``block_transform`` — see models/gpt2.py."""
+    forward passes coincide. ``block_transform`` — see models/gpt2.py.
+    ``seq_axis`` — sequence-sharded (context-parallel) call: RoPE angles are
+    offset by the shard's global start and attention runs the ring kernel."""
     del dropout_key, deterministic
     b, t = input_ids.shape
-    if t > cfg.n_ctx:
-        raise ValueError(f"sequence length {t} exceeds n_ctx {cfg.n_ctx}")
+    # Global length under sequence sharding (shards × local t): RoPE would
+    # silently extrapolate past the trained context window otherwise.
+    global_t = t * (jax.lax.psum(1, seq_axis) if seq_axis is not None else 1)
+    if global_t > cfg.n_ctx:
+        raise ValueError(
+            f"sequence length {global_t} exceeds n_ctx {cfg.n_ctx}"
+        )
     dtype = jnp.dtype(cfg.dtype)
 
     x = params["wte"][input_ids].astype(dtype)
-    cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta)
+    offset = (
+        jax.lax.axis_index(seq_axis) * t if seq_axis is not None else 0
+    )
+    cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta, offset=offset)
 
     def scan_body(carry, bp):
         if block_transform is not None:
             bp = block_transform(bp)
-        return _block(carry, bp, cfg, cos, sin), None
+        return _block(carry, bp, cfg, cos, sin, seq_axis), None
 
     body = apply_remat(scan_body, cfg.remat)
     x, _ = jax.lax.scan(body, x, params["blocks"])

@@ -86,8 +86,23 @@ def multi_head_attention(
     dropout_rate: float = 0.0,
     dropout_key: jax.Array | None = None,
     deterministic: bool = True,
+    seq_axis: str | None = None,
 ) -> jax.Array:
-    """Dispatch over attention implementations. Inputs [B, T, H(kv), D]."""
+    """Dispatch over attention implementations. Inputs [B, T, H(kv), D].
+
+    ``seq_axis``: name of a shard_map mesh axis the sequence dim is sharded
+    over — selects ring attention (sequence/context parallelism) regardless
+    of ``impl``. Attention dropout is unsupported under sequence sharding
+    (the reference has no sequence parallelism at all, SURVEY.md §5.7).
+    """
+    if seq_axis is not None:
+        from pytorch_distributed_tpu.ops.ring_attention import ring_attention
+
+        if not deterministic and dropout_rate > 0.0:
+            raise NotImplementedError(
+                "attention dropout is not supported with sequence parallelism"
+            )
+        return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
     if impl == "naive":
         return naive_attention(
             q, k, v,

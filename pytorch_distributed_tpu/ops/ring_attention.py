@@ -1,0 +1,116 @@
+"""Ring attention: sequence-parallel causal attention over a mesh axis.
+
+Long-context scaling the reference cannot do at all (SURVEY.md §5.7: the
+reference materialises the full T×T score matrix, reference my_gpt2.py:63-77,
+and is hard-capped at n_ctx by its precomputed mask buffer, :29-36). Here the
+sequence dimension is sharded over a mesh axis: each device holds a
+[B, T/N, H, D] slice of Q/K/V, and K/V blocks rotate around the ring via
+``lax.ppermute`` while each device accumulates its queries' attention with a
+flash-style online softmax. Peak memory per device is O(T/N · T/N) for one
+score block instead of O(T²); ICI neighbour-exchange bandwidth overlaps with
+the per-block matmuls.
+
+Math (standard blockwise softmax accumulation): per incoming KV block
+  s   = q·kᵀ/√d  (masked)
+  m'  = max(m, rowmax(s))
+  p   = exp(s - m')
+  o   = o·exp(m-m') + p·v
+  l   = l·exp(m-m') + rowsum(p)
+and ``out = o / l`` after the ring completes. The self block is processed
+first (step 0), so ``m`` is finite from the first accumulation — every causal
+query row attends at least to itself.
+
+Must be called inside ``shard_map`` with ``axis_name`` bound and the sequence
+dim of q/k/v sharded over that axis. Differentiable end-to-end: the ring is a
+``lax.scan`` and AD transposes each ``ppermute`` into the reverse rotation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.ops.attention import NEG_INF, _repeat_kv
+
+
+def ring_attention(
+    q: jax.Array,  # [B, Tl, H, D] — local query shard
+    k: jax.Array,  # [B, Tl, Hkv, D]
+    v: jax.Array,  # [B, Tl, Hkv, D]
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Returns the local output shard [B, Tl, H, D].
+
+    Global semantics are identical to ``naive_attention`` on the unsharded
+    [B, T, H, D] arrays (tested vs. it in tests/test_ring_attention.py).
+    Softmax statistics are kept in float32 regardless of input dtype.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, tl, h, d = q.shape
+    n_rep = h // k.shape[2]
+
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, dtype=jnp.float32))
+    # Send each device's KV block to the NEXT device: after s steps, device
+    # idx holds the block that started on device (idx - s) mod n.
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qpos = idx * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 0)
+
+    def accumulate(acc, kb, vb, step):
+        """Fold one KV block into the running (o, m, l) softmax state."""
+        o, m, l = acc
+        src = (idx - step) % n
+        # GQA heads are expanded here, AFTER the ring exchange, so the
+        # neighbour traffic moves the unexpanded [B, Tl, Hkv, D] blocks.
+        kb = _repeat_kv(kb, n_rep)
+        vb = _repeat_kv(vb, n_rep)
+
+        # [B, H, Tl, Tl] block scores in f32 (one MXU matmul per block).
+        s = (
+            jnp.einsum("bthd,bshd->bhts", q, kb,
+                       preferred_element_type=jnp.float32)
+            * scale
+        )
+        if causal:
+            kpos = src * tl + jax.lax.broadcasted_iota(jnp.int32, (tl, tl), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # Fully-masked blocks (src > idx) leave m unchanged; p underflows to 0
+        # because m is already finite after the step-0 self block.
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhts,bshd->bhtd", p, vb.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        l = l * alpha + jnp.sum(p, axis=-1)
+        return o, m_new, l
+
+    def ring_step(carry, step):
+        kb, vb, acc = carry
+        acc = accumulate(acc, kb, vb, step)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, acc), None
+
+    # Accumulators hold device-varying values; mark them so under shard_map's
+    # varying-manual-axes typing (constants start out unvarying).
+    def varying(x):
+        return jax.lax.pcast(x, (axis_name,), to="varying")
+
+    acc0 = (
+        varying(jnp.zeros((b, h, tl, d), jnp.float32)),
+        varying(jnp.full((b, h, tl), NEG_INF, jnp.float32)),
+        varying(jnp.zeros((b, h, tl), jnp.float32)),
+    )
+    # n-1 exchange steps in the scan; the final block needs no ppermute.
+    (kb, vb, acc), _ = jax.lax.scan(
+        ring_step, (k, v, acc0), jnp.arange(n - 1)
+    )
+    o, m, l = accumulate(acc, kb, vb, n - 1)
+
+    out = o / l[..., None]
+    return out.transpose(0, 2, 1, 3).astype(v.dtype)

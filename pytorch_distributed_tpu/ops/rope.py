@@ -13,14 +13,15 @@ import jax.numpy as jnp
 
 
 def rope_angles(
-    seq_len: int, head_dim: int, theta: float, *, offset: int = 0
+    seq_len: int, head_dim: int, theta: float, *, offset=0
 ) -> tuple[jax.Array, jax.Array]:
-    """Returns (cos, sin), each [seq_len, head_dim] float32."""
+    """Returns (cos, sin), each [seq_len, head_dim] float32. ``offset`` may be
+    a traced scalar (e.g. a sequence-shard start under context parallelism)."""
     half = head_dim // 2
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, half, dtype=jnp.float32) * 2.0 / head_dim)
     )
-    pos = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    pos = jnp.arange(seq_len, dtype=jnp.float32) + offset
     angles = jnp.outer(pos, inv_freq)  # [T, half]
     angles = jnp.concatenate([angles, angles], axis=-1)  # [T, D]
     return jnp.cos(angles), jnp.sin(angles)

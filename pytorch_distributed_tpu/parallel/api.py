@@ -24,14 +24,16 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
 import optax
 
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 from pytorch_distributed_tpu.models import ModelApi
-from pytorch_distributed_tpu.parallel.mesh import batch_partition_spec
+from pytorch_distributed_tpu.parallel.mesh import (
+    batch_partition_spec,
+    make_batch_put,
+)
 from pytorch_distributed_tpu.parallel.sharding import state_shardings
 from pytorch_distributed_tpu.train.state import TrainState
 from pytorch_distributed_tpu.train.trainer import make_train_step
@@ -68,10 +70,4 @@ def make_parallel_train_step(
         donate_argnums=(0,),
     )
 
-    def batch_put(batch: dict) -> dict:
-        return {
-            k: jax.device_put(np.asarray(v), batch_sharding)
-            for k, v in batch.items()
-        }
-
-    return step, batch_put
+    return step, make_batch_put(mesh, mesh_cfg)

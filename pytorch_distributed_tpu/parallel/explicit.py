@@ -111,9 +111,17 @@ def make_explicit_train_step(
     """Build a jitted explicit-collective (state, batch, key) -> (state,
     metrics) step. State must already be placed per
     parallel.sharding.shard_train_state (same shardings as the pjit path)."""
-    if mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
+    if mesh_cfg.tensor > 1:
         raise NotImplementedError(
-            "explicit path covers data/fsdp axes; tensor/seq use the pjit path"
+            "explicit path covers data/fsdp/seq axes; tensor uses the pjit path"
+        )
+    seq_axis = "seq" if mesh_cfg.seq > 1 else None
+    if seq_axis is not None and model_cfg.attn_pdrop > 0:
+        # Fail at build time, not mid-trace on the first step (ring attention
+        # has no attention-dropout support, ops/attention.py).
+        raise NotImplementedError(
+            "attention dropout is not supported with sequence parallelism "
+            f"(attn_pdrop={model_cfg.attn_pdrop}); set attn_pdrop=0.0"
         )
     strategy = mesh_cfg.strategy
     fsdp_size = mesh_cfg.fsdp
@@ -152,6 +160,10 @@ def make_explicit_train_step(
         gather_block = None
 
     def forward_loss(params_shard, inputs, targets, key):
+        if seq_axis is not None and train_mode:
+            # Independent dropout masks per sequence shard (embd/resid
+            # dropout acts on the local T/N slice).
+            key = jax.random.fold_in(key, jax.lax.axis_index(seq_axis))
         if strategy == "full_shard" and fsdp_size > 1:
             # Non-block leaves (embeddings, final norm) are gathered up
             # front; each scanned layer gathers its own block just in time
@@ -173,6 +185,7 @@ def make_explicit_train_step(
             deterministic=not train_mode,
             dropout_key=key,
             block_transform=gather_block,
+            seq_axis=seq_axis,
         )
         return cross_entropy_loss(logits, targets)
 
@@ -221,6 +234,13 @@ def make_explicit_train_step(
             # DDP: one all-reduce(AVG) over every batch axis.
             for ax in dp_axes:
                 grads = jax.lax.pmean(grads, ax)
+
+        # Context parallelism: params are replicated across "seq", each shard
+        # computed grads of its local-token mean loss — the global-mean grad
+        # and loss are the seq-average of both.
+        if seq_axis is not None:
+            grads = jax.lax.pmean(grads, seq_axis)
+            loss = jax.lax.pmean(loss, seq_axis)
 
         # loss all-reduce(AVG) (reference distributed_trainer.py:131-154).
         for ax in dp_axes:

@@ -80,6 +80,24 @@ def batch_partition_spec(cfg: MeshConfig) -> P:
     return P(None, batch_axes, seq_axis)
 
 
+def make_batch_put(mesh: Mesh, cfg: MeshConfig):
+    """Returns a function placing a host {inputs, targets} batch of [A, B, T]
+    arrays onto the mesh with the batch sharding (single source of truth for
+    batch placement — used by the pjit path, the explicit path, and entry
+    scripts)."""
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, batch_partition_spec(cfg))
+
+    def put(batch: dict) -> dict:
+        return {
+            k: jax.device_put(np.asarray(v), sharding)
+            for k, v in batch.items()
+        }
+
+    return put
+
+
 def data_parallel_size(cfg: MeshConfig) -> int:
     """How many ways the batch is split (the 'world size' in the reference's
     grad-accum rule, distributed_trainer.py:84-88)."""

@@ -77,7 +77,7 @@ def measure_tokens_per_second(
 
     for i in range(warmup_steps):
         state, metrics = step(state, batch, jax.random.fold_in(dkey, i))
-    float(jax.device_get(metrics["loss"]))  # fence
+        float(jax.device_get(metrics["loss"]))  # fence
 
     t0 = time.perf_counter()
     for i in range(num_steps):

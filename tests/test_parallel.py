@@ -27,6 +27,7 @@ from pytorch_distributed_tpu.parallel.explicit import make_explicit_train_step
 from pytorch_distributed_tpu.parallel.mesh import (
     batch_partition_spec,
     data_parallel_size,
+    make_batch_put,
 )
 from pytorch_distributed_tpu.parallel.sharding import param_partition_specs
 from pytorch_distributed_tpu.train.optim import make_optimizer
@@ -64,26 +65,28 @@ def setup(eight_devices):
 
 
 STRATEGIES = [
-    ("no_shard", 8, 1),
-    ("full_shard", 1, 8),
-    ("full_shard", 2, 4),
-    ("shard_grad_op", 1, 8),
-    ("shard_grad_op", 2, 4),
+    ("no_shard", 8, 1, 1),
+    ("full_shard", 1, 8, 1),
+    ("full_shard", 2, 4, 1),
+    ("shard_grad_op", 1, 8, 1),
+    ("shard_grad_op", 2, 4, 1),
+    # Context parallelism (ring attention over the seq axis), alone and
+    # composed with DP and FSDP.
+    ("no_shard", 1, 1, 8),
+    ("no_shard", 2, 1, 4),
+    ("full_shard", 1, 2, 4),
 ]
 
 
-def _run_one(setup, strategy, data, fsdp, path):
+def _run_one(setup, strategy, data, fsdp, path, seq=1):
     cfg, tx, model = setup["cfg"], setup["tx"], setup["model"]
-    mcfg = MeshConfig(data=data, fsdp=fsdp, strategy=strategy)
+    mcfg = MeshConfig(data=data, fsdp=fsdp, seq=seq, strategy=strategy)
     mesh = make_mesh(mcfg)
     state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
     state, _ = shard_train_state(state, mesh, mcfg)
     if path == "explicit":
         step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
-        bs = NamedSharding(mesh, batch_partition_spec(mcfg))
-        batch = {
-            k: jax.device_put(v, bs) for k, v in setup["batch"].items()
-        }
+        batch = make_batch_put(mesh, mcfg)(setup["batch"])
     else:
         step, put = make_parallel_train_step(model, cfg, tx, mesh, mcfg, state)
         batch = put(setup["batch"])
@@ -91,10 +94,10 @@ def _run_one(setup, strategy, data, fsdp, path):
     return new_state, metrics
 
 
-@pytest.mark.parametrize("strategy,data,fsdp", STRATEGIES)
+@pytest.mark.parametrize("strategy,data,fsdp,seq", STRATEGIES)
 @pytest.mark.parametrize("path", ["auto", "explicit"])
-def test_parallel_matches_single_device(setup, strategy, data, fsdp, path):
-    new_state, metrics = _run_one(setup, strategy, data, fsdp, path)
+def test_parallel_matches_single_device(setup, strategy, data, fsdp, seq, path):
+    new_state, metrics = _run_one(setup, strategy, data, fsdp, path, seq=seq)
     assert float(metrics["loss"]) == pytest.approx(setup["ref_loss"], abs=1e-5)
     assert float(metrics["grad_norm"]) == pytest.approx(
         setup["ref_gnorm"], abs=1e-4
