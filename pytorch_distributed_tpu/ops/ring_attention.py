@@ -18,7 +18,10 @@ Math (standard blockwise softmax accumulation): per incoming KV block
   l   = l·exp(m-m') + rowsum(p)
 and ``out = o / l`` after the ring completes. The self block is processed
 first (step 0), so ``m`` is finite from the first accumulation — every causal
-query row attends at least to itself.
+query row attends at least to itself. Fully-masked future blocks (source
+shard > own shard) skip their matmuls via ``lax.cond``; the ring still pays
+all n exchanges and the last shard does the most useful work (n blocks vs 1
+for shard 0) — inherent to contiguous-block causal CP.
 
 Must be called inside ``shard_map`` with ``axis_name`` bound and the sequence
 dim of q/k/v sharded over that axis. Differentiable end-to-end: the ring is a
@@ -91,7 +94,21 @@ def ring_attention(
 
     def ring_step(carry, step):
         kb, vb, acc = carry
-        acc = accumulate(acc, kb, vb, step)
+        if causal:
+            # Blocks from later shards (src > idx) are fully masked — skip
+            # their matmuls entirely. (The ring still pays n exchanges and is
+            # load-imbalanced: device idx does idx+1 useful blocks. A
+            # striped/zigzag token layout would balance it at the cost of a
+            # permuted data contract; not worth it at parity scale.)
+            src = (idx - step) % n
+            acc = jax.lax.cond(
+                src <= idx,
+                lambda a: accumulate(a, kb, vb, step),
+                lambda a: a,
+                acc,
+            )
+        else:
+            acc = accumulate(acc, kb, vb, step)
         kb = jax.lax.ppermute(kb, axis_name, perm)
         vb = jax.lax.ppermute(vb, axis_name, perm)
         return (kb, vb, acc), None

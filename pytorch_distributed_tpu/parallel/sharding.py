@@ -32,45 +32,116 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from pytorch_distributed_tpu.config import MeshConfig
 from pytorch_distributed_tpu.train.state import TrainState
 
+# Megatron-style tensor-parallel placement, keyed by param-path suffix.
+# Dim indices are for the STACKED [L, in, out] (kernel) / [L, out] (bias)
+# block leaves. Column-parallel layers (QKV / up-projections) shard the
+# output dim; the following row-parallel projection shards its input dim, so
+# the only forward collective is one psum after c_proj/wo/down — XLA's SPMD
+# partitioner places it from these specs alone.
+_TENSOR_RULES: dict[tuple[str, ...], int] = {
+    # gpt2 (models/gpt2.py layout)
+    ("attn", "c_attn", "kernel"): 2,
+    ("attn", "c_attn", "bias"): 1,
+    ("attn", "c_proj", "kernel"): 1,
+    ("mlp", "c_fc", "kernel"): 2,
+    ("mlp", "c_fc", "bias"): 1,
+    ("mlp", "c_proj", "kernel"): 1,
+    # llama (models/llama.py layout)
+    ("attn", "wq"): 2,
+    ("attn", "wk"): 2,
+    ("attn", "wv"): 2,
+    ("attn", "wo"): 1,
+    ("mlp", "gate"): 2,
+    ("mlp", "up"): 2,
+    ("mlp", "down"): 1,
+}
+_TENSOR_SUFFIX_LENS = (3, 2)
+
+
+def _path_keys(path) -> tuple[str, ...]:
+    """String keys of a jax tree path (non-string entries like list indices
+    in optimizer state become their repr, which never matches a rule)."""
+    return tuple(
+        getattr(p, "key", None) if isinstance(getattr(p, "key", None), str)
+        else str(p)
+        for p in path
+    )
+
+
+def _tensor_dim(path) -> int | None:
+    keys = _path_keys(path)
+    for n in _TENSOR_SUFFIX_LENS:
+        if len(keys) >= n and keys[-n:] in _TENSOR_RULES:
+            return _TENSOR_RULES[keys[-n:]]
+    return None
+
 
 def _leaf_spec(
     shape: tuple[int, ...],
-    axis_size: int,
-    axis_name: str,
+    mesh_cfg: MeshConfig,
     *,
+    path,
+    shard_fsdp: bool,
     min_dim: int = 0,
 ) -> P:
-    """Shard the largest divisible dim >= min_dim along axis_name
-    (ties -> last dim)."""
-    if axis_size == 1 or not shape:
+    """Combined tensor + fsdp spec for one leaf: the tensor rule (if any)
+    claims its dim, then fsdp shards the largest remaining divisible dim
+    >= min_dim (ties -> last dim)."""
+    if not shape:
         return P()
-    best_dim, best_size = None, 0
-    for i, s in enumerate(shape):
-        if i >= min_dim and s % axis_size == 0 and s >= best_size and s > 1:
-            best_dim, best_size = i, s
-    if best_dim is None:
-        return P()  # small leaf (e.g. scalars, LN vectors) — replicate
-    spec = [None] * len(shape)
-    spec[best_dim] = axis_name
+    spec: list = [None] * len(shape)
+
+    tdim = _tensor_dim(path) if mesh_cfg.tensor > 1 else None
+    if tdim is not None:
+        if shape[tdim] % mesh_cfg.tensor != 0:
+            # Silent fallback would replicate this leaf tensor-ways — an
+            # invisible memory regression at scale. Refuse instead.
+            raise ValueError(
+                f"tensor-parallel dim {tdim} of param "
+                f"{'/'.join(_path_keys(path))} (shape {shape}) is not "
+                f"divisible by tensor={mesh_cfg.tensor}"
+            )
+        spec[tdim] = "tensor"
+
+    if shard_fsdp and mesh_cfg.fsdp > 1:
+        best_dim, best_size = None, 0
+        for i, s in enumerate(shape):
+            if (
+                i >= min_dim
+                and spec[i] is None
+                and s % mesh_cfg.fsdp == 0
+                and s >= best_size
+                and s > 1
+            ):
+                best_dim, best_size = i, s
+        if best_dim is not None:
+            spec[best_dim] = "fsdp"
+
+    if all(ax is None for ax in spec):
+        return P()
     return P(*spec)
 
 
 def param_partition_specs(params, mesh_cfg: MeshConfig):
     """PartitionSpec pytree for model params under the configured strategy.
 
+    Tensor-parallel sharding (the "tensor" axis) applies under every FSDP
+    strategy — TP is orthogonal to the ZeRO level. FSDP sharding of params
+    applies only under full_shard.
+
     Leaves under a top-level "blocks" key are layer-stacked [L, ...]; their
     leading dim is never sharded so scan-over-layers slices stay local and
     per-layer gathers (explicit FSDP) keep working.
     """
-    if mesh_cfg.strategy in ("no_shard", "shard_grad_op") or mesh_cfg.fsdp == 1:
-        return jax.tree.map(lambda _: P(), params)
+    shard_fsdp = mesh_cfg.strategy == "full_shard"
 
     def spec_for(path, leaf):
         stacked = bool(path) and getattr(path[0], "key", None) == "blocks"
         return _leaf_spec(
             tuple(leaf.shape),
-            mesh_cfg.fsdp,
-            "fsdp",
+            mesh_cfg,
+            path=path,
+            shard_fsdp=shard_fsdp,
             min_dim=1 if stacked else 0,
         )
 
@@ -80,11 +151,11 @@ def param_partition_specs(params, mesh_cfg: MeshConfig):
 def opt_state_partition_specs(opt_state, params_specs, mesh_cfg: MeshConfig):
     """Optimizer-state sharding. Adam moments mirror the params tree shape;
     for full_shard they follow the param specs, for shard_grad_op they are
-    sharded even though params are replicated (ZeRO-2), for no_shard
-    replicated. Scalar leaves (step counts) stay replicated."""
+    fsdp-sharded even though params are replicated (ZeRO-2), for no_shard
+    fsdp-replicated. Tensor-parallel dims always mirror the params (moments
+    live where their params live). Scalar leaves (step counts) replicate."""
     del params_specs  # moments share param shapes; specs derive from shapes
-    if mesh_cfg.strategy == "no_shard" or mesh_cfg.fsdp == 1:
-        return jax.tree.map(lambda _: P(), opt_state)
+    shard_fsdp = mesh_cfg.strategy in ("full_shard", "shard_grad_op")
 
     def leaf_spec(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()))
@@ -92,7 +163,11 @@ def opt_state_partition_specs(opt_state, params_specs, mesh_cfg: MeshConfig):
             return P()
         stacked = any(getattr(p, "key", None) == "blocks" for p in path)
         return _leaf_spec(
-            shape, mesh_cfg.fsdp, "fsdp", min_dim=1 if stacked else 0
+            shape,
+            mesh_cfg,
+            path=path,
+            shard_fsdp=shard_fsdp,
+            min_dim=1 if stacked else 0,
         )
 
     return jax.tree_util.tree_map_with_path(leaf_spec, opt_state)
