@@ -51,10 +51,17 @@ class ModelConfig:
     # Numerics: params kept in param_dtype, activations computed in dtype.
     dtype: str = "bfloat16"
     param_dtype: str = "float32"
+    # Dtype the LM head emits. float32 matches the reference's fp32 logits;
+    # "bfloat16" halves the [B, T, V] HBM traffic through the head + loss
+    # (the MXU still accumulates in f32; cross-entropy upcasts to f32).
+    logits_dtype: str = "float32"
 
     # Selective activation checkpointing per block (reference my_gpt2.py:145,
     # 175-183 + pytorch_utils.py:5-17): save compute-intensive matmul outputs,
-    # recompute the rest. One of: "none", "dots" (selective), "full".
+    # recompute the rest. One of: "none", "full", "dots", "dots_no_batch",
+    # or "names" (recommended: saves the tagged projection outputs and the
+    # flash kernel's o/l/m, but never the quadratic score matrix — see
+    # ops/remat.py).
     remat: str = "dots"
 
     # Attention implementation: "naive" (materialises the T×T score matrix like

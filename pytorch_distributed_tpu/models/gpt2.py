@@ -39,9 +39,23 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.config import ModelConfig
 from pytorch_distributed_tpu.ops.attention import multi_head_attention
 from pytorch_distributed_tpu.ops.layers import activation, dense, dropout, layer_norm
-from pytorch_distributed_tpu.ops.remat import apply_remat
+from pytorch_distributed_tpu.ops.remat import apply_remat, checkpoint_name
 
 Params = dict[str, Any]
+
+
+def _flash_kernel_active(
+    cfg: ModelConfig, t: int, seq_axis: str | None
+) -> bool:
+    """True when attention will run the Pallas kernel, whose (o, l, m)
+    outputs the "names" remat policy saves directly."""
+    from pytorch_distributed_tpu.ops.pallas_flash import _pallas_supported
+
+    return (
+        cfg.attention_impl == "flash"
+        and seq_axis is None
+        and _pallas_supported(t, t, cfg.head_dim)
+    )
 
 
 def init(key: jax.Array, cfg: ModelConfig) -> Params:
@@ -110,7 +124,7 @@ def _block(
 
     # --- attention sub-block (reference my_gpt2.py:38-77, merged QKV :21) ---
     a = layer_norm(x, bp["ln_1"], eps=eps)
-    qkv = dense(a, bp["attn"]["c_attn"])  # [B, T, 3E]
+    qkv = checkpoint_name(dense(a, bp["attn"]["c_attn"]), "qkv")  # [B, T, 3E]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(b, t, h, d)
     k = k.reshape(b, t, h, d)
@@ -124,15 +138,20 @@ def _block(
         deterministic=deterministic,
         seq_axis=seq_axis,
     ).reshape(b, t, e)
-    a = dense(a, bp["attn"]["c_proj"])
+    if not _flash_kernel_active(cfg, t, seq_axis):
+        # On the Pallas path the kernel's o output is already saved by the
+        # remat policy (ops/remat._flash_call_policy); tagging here too would
+        # store the same tensor twice (~12 MB/layer at bench shapes).
+        a = checkpoint_name(a, "attn_out")
+    a = checkpoint_name(dense(a, bp["attn"]["c_proj"]), "attn_proj")
     a = dropout(a, cfg.resid_pdrop, k_resid1, deterministic=deterministic)
     x = x + a
 
     # --- MLP sub-block (reference my_gpt2.py:80-99) ---
     m = layer_norm(x, bp["ln_2"], eps=eps)
-    m = dense(m, bp["mlp"]["c_fc"])
+    m = checkpoint_name(dense(m, bp["mlp"]["c_fc"]), "mlp_fc")
     m = activation(cfg.activation_function)(m)
-    m = dense(m, bp["mlp"]["c_proj"])
+    m = checkpoint_name(dense(m, bp["mlp"]["c_proj"]), "mlp_proj")
     m = dropout(m, cfg.resid_pdrop, k_mlp, deterministic=deterministic)
     return x + m
 
@@ -207,9 +226,10 @@ def apply(
     x, _ = jax.lax.scan(body, x, (params["blocks"], layer_ids))
 
     x = layer_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
-    # Tied LM head (reference my_gpt2.py:200-206): logits = x @ wte^T, in f32.
+    # Tied LM head (reference my_gpt2.py:200-206): logits = x @ wte^T. The MXU
+    # accumulates in f32; cfg.logits_dtype controls what lands in HBM.
     logits = jnp.einsum(
         "bte,ve->btv", x, params["wte"].astype(x.dtype),
         preferred_element_type=jnp.float32,
     )
-    return logits
+    return logits.astype(jnp.dtype(cfg.logits_dtype))

@@ -27,9 +27,10 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_distributed_tpu.config import ModelConfig
+from pytorch_distributed_tpu.models.gpt2 import _flash_kernel_active
 from pytorch_distributed_tpu.ops.attention import multi_head_attention
 from pytorch_distributed_tpu.ops.layers import rms_norm
-from pytorch_distributed_tpu.ops.remat import apply_remat
+from pytorch_distributed_tpu.ops.remat import apply_remat, checkpoint_name
 from pytorch_distributed_tpu.ops.rope import apply_rope, rope_angles
 
 Params = dict[str, Any]
@@ -73,21 +74,29 @@ def _block(x, bp, cfg: ModelConfig, cos, sin, seq_axis=None):
     h, kv, d = cfg.n_head, cfg.kv_heads, cfg.head_dim
 
     a = rms_norm(x, bp["ln_attn"], eps=eps)
-    q = (a @ bp["attn"]["wq"].astype(a.dtype)).reshape(b, t, h, d)
-    k = (a @ bp["attn"]["wk"].astype(a.dtype)).reshape(b, t, kv, d)
-    v = (a @ bp["attn"]["wv"].astype(a.dtype)).reshape(b, t, kv, d)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
+    q = checkpoint_name(a @ bp["attn"]["wq"].astype(a.dtype), "q")
+    k = checkpoint_name(a @ bp["attn"]["wk"].astype(a.dtype), "k")
+    v = checkpoint_name(a @ bp["attn"]["wv"].astype(a.dtype), "v")
+    q = apply_rope(q.reshape(b, t, h, d), cos, sin)
+    k = apply_rope(k.reshape(b, t, kv, d), cos, sin)
+    v = v.reshape(b, t, kv, d)
     a = multi_head_attention(
         q, k, v, impl=cfg.attention_impl, causal=True, deterministic=True,
         seq_axis=seq_axis,
     ).reshape(b, t, h * d)
-    x = x + a @ bp["attn"]["wo"].astype(a.dtype)
+    if not _flash_kernel_active(cfg, t, seq_axis):
+        # Pallas path: the kernel's o is already policy-saved (see gpt2.py).
+        a = checkpoint_name(a, "attn_out")
+    x = x + checkpoint_name(a @ bp["attn"]["wo"].astype(a.dtype), "attn_proj")
 
     m = rms_norm(x, bp["ln_mlp"], eps=eps)
-    gate = jax.nn.silu(m @ bp["mlp"]["gate"].astype(m.dtype))
-    up = m @ bp["mlp"]["up"].astype(m.dtype)
-    x = x + (gate * up) @ bp["mlp"]["down"].astype(m.dtype)
+    gate = jax.nn.silu(
+        checkpoint_name(m @ bp["mlp"]["gate"].astype(m.dtype), "mlp_gate")
+    )
+    up = checkpoint_name(m @ bp["mlp"]["up"].astype(m.dtype), "mlp_up")
+    x = x + checkpoint_name(
+        (gate * up) @ bp["mlp"]["down"].astype(m.dtype), "mlp_proj"
+    )
     return x
 
 
@@ -135,4 +144,4 @@ def apply(
     return jnp.einsum(
         "bte,ev->btv", x, params["lm_head"].astype(x.dtype),
         preferred_element_type=jnp.float32,
-    )
+    ).astype(jnp.dtype(cfg.logits_dtype))

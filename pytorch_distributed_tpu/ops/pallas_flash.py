@@ -3,14 +3,16 @@
 The reference's attention materialises the full [B, H, T, T] score matrix
 (reference my_gpt2.py:60-77) and lists torch's flash/efficient SDPA kernels as
 compute-intensive save-targets (reference model/pytorch_utils.py:9-13) without
-ever calling them. Here flash attention is a first-class implementation:
-O(T · block) memory via the online-softmax recurrence, scanned over key
-blocks with `lax.scan` so XLA keeps a small working set; differentiable by
-ordinary AD (the scan is linearised — no hand-written VJP needed).
+ever calling them. Here flash attention is a first-class implementation with
+two backends behind one entry point:
 
-`flash_attention` is the stable entry point; a hand-tiled Pallas TPU kernel
-(same signature, same math) plugs in behind it for the hot path — see
-ops/pallas_flash_kernel.py once present.
+- ``pallas``: the hand-tiled Mosaic/Pallas TPU kernel
+  (``jax.experimental.pallas.ops.tpu.flash_attention``) — VMEM-resident
+  blocks, online softmax, custom VJP that recomputes attention in backward.
+  Used automatically on TPU when shapes are tileable.
+- ``blockwise``: a pure-XLA `lax.scan` over key blocks with the same
+  online-softmax recurrence — O(T · block) memory, differentiable by
+  ordinary AD. The portable fallback (CPU tests, ragged shapes).
 
 GQA is supported by repeating KV heads, like the naive path.
 """
@@ -27,10 +29,25 @@ from pytorch_distributed_tpu.ops.attention import NEG_INF, _repeat_kv
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
+# The TPU kernel tiles the sequence into lane-width multiples; anything
+# smaller (tiny test configs) takes the blockwise path.
+_PALLAS_MIN_SEQ = 128
 
-@functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k")
-)
+
+def _pallas_supported(t: int, s: int, d: int) -> bool:
+    if jax.devices()[0].platform != "tpu":
+        return False
+    # t == s only: for S > T (decoding with a cache) the library kernel masks
+    # query i at absolute position i, whereas this module's convention aligns
+    # the last query with the last key (q_offset = s - t) — the blockwise
+    # path handles that case correctly.
+    return (
+        t == s
+        and t % _PALLAS_MIN_SEQ == 0
+        and d % 64 == 0
+    )
+
+
 def flash_attention(
     q: jax.Array,  # [B, T, H, D]
     k: jax.Array,  # [B, S, Hkv, D]
@@ -41,6 +58,131 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
     """Blockwise causal attention, [B, T, H, D] -> [B, T, H, D].
+
+    Dispatches to the Pallas TPU kernel when running on TPU with tileable
+    shapes, else to the portable scan implementation.
+    """
+    b, t, h, d = q.shape
+    s = k.shape[1]
+    if _pallas_supported(t, s, d):
+        return _pallas_flash(q, k, v, causal=causal)
+    return blockwise_attention(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k
+    )
+
+
+def _largest_divisor_block(n: int, candidates=(1024, 512, 256, 128)) -> int:
+    for c in candidates:
+        if n % c == 0:
+            return c
+    return n
+
+
+def _block_sizes(t: int, s: int):
+    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
+
+    bq = _largest_divisor_block(t)
+    bk = _largest_divisor_block(s, (512, 256, 128))
+    return BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk,
+        block_k_dkv=bk, block_q_dkv=bq,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _pallas_flash_olm(q, k, v, causal, sm_scale, block_sizes):
+    """Flash attention whose PRIMAL returns (o, l, m) — output plus the
+    softmax statistics the backward kernels need.
+
+    Exposing l/m as primal outputs (instead of hiding them inside the
+    library custom_vjp's forward re-run) lets a remat policy save them:
+    with (o, l, m) saved and q/k/v recomputable from the saved qkv
+    projection, the backward pass runs ONLY the dq/dkv kernels — no
+    second forward kernel launch. Measured ~5 ms/step on GPT-2 124M B=8.
+    """
+    import jax.experimental.pallas.ops.tpu.flash_attention as _lib
+
+    o, l, m = _lib._flash_attention_impl(
+        q, k, v, None, None, True, causal, sm_scale,
+        block_sizes.block_b, block_sizes.block_q,
+        block_sizes.block_k_major, block_sizes.block_k, False,
+    )
+    return o, l, m
+
+
+def _pallas_flash_olm_fwd(q, k, v, causal, sm_scale, block_sizes):
+    o, l, m = _pallas_flash_olm(q, k, v, causal, sm_scale, block_sizes)
+    return (o, l, m), (q, k, v, o, l, m)
+
+
+def _pallas_flash_olm_bwd(causal, sm_scale, block_sizes, res, cts):
+    import jax.experimental.pallas.ops.tpu.flash_attention as _lib
+
+    q, k, v, o, l, m = res
+    do = cts[0]  # l/m are consumed by nothing differentiable: zero cotangents
+    di = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    dk, dv = _lib._flash_attention_bwd_dkv(
+        q, k, v, None, None, l, m, do, di,
+        block_q_major=block_sizes.block_q_major_dkv,
+        block_k_major=block_sizes.block_k_major_dkv,
+        block_k=block_sizes.block_k_dkv,
+        block_q=block_sizes.block_q_dkv,
+        sm_scale=sm_scale, causal=causal,
+        mask_value=_lib.DEFAULT_MASK_VALUE, debug=False,
+    )
+    dq, _ = _lib._flash_attention_bwd_dq(
+        q, k, v, None, None, l, m, do, di,
+        block_q_major=block_sizes.block_q_dq,
+        block_k_major=block_sizes.block_k_major_dq,
+        block_k=block_sizes.block_k_dq,
+        sm_scale=sm_scale, causal=causal,
+        mask_value=_lib.DEFAULT_MASK_VALUE, debug=False,
+    )
+    return dq, dk, dv
+
+
+_pallas_flash_olm.defvjp(_pallas_flash_olm_fwd, _pallas_flash_olm_bwd)
+
+
+def _pallas_flash(q, k, v, *, causal: bool) -> jax.Array:
+    """[B, T, H, D] wrapper around the [B, H, T, D] Pallas TPU kernel.
+
+    Block sizes are tuned for v5e: large q blocks with 512-wide k blocks
+    measured ~1.6x faster fwd+bwd than the kernel's 128-wide defaults at
+    T=1024, D=64 (and beat the XLA naive path, which they must to be worth
+    dispatching to).
+    """
+    h = q.shape[2]
+    k = _repeat_kv(k, h // k.shape[2])
+    v = _repeat_kv(v, h // v.shape[2])
+    d = q.shape[-1]
+    t, s = q.shape[1], k.shape[1]
+    out, _, _ = _pallas_flash_olm(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal,
+        1.0 / (d**0.5),
+        _block_sizes(t, s),
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k")
+)
+def blockwise_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,  # [B, S, Hkv, D]
+    *,
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+) -> jax.Array:
+    """Pure-XLA blockwise causal attention, [B, T, H, D] -> [B, T, H, D].
 
     Accumulators (running max m, normaliser l, output acc) are float32.
     """
