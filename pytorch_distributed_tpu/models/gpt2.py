@@ -18,15 +18,22 @@ TPU-first design (NOT a translation of the torch class hierarchy):
 - dropout uses explicit PRNG keys folded per (step, layer).
 
 Params layout (shapes for config E=n_embd, L=n_layer, V=vocab, C=n_ctx,
-F=inner_dim, Q=3E merged qkv):
+F=inner_dim, H=n_head, D=head_dim):
   wte [V, E]; wpe [C, E]
   blocks/ln_1 {scale[L,E], bias[L,E]}     blocks/ln_2 same
-  blocks/attn/c_attn {kernel[L,E,Q], bias[L,Q]}
+  blocks/attn/c_attn {kernel[L,E,3,H,D], bias[L,3,H,D]}
   blocks/attn/c_proj {kernel[L,E,E], bias[L,E]}
   blocks/mlp/c_fc   {kernel[L,E,F], bias[L,F]}
   blocks/mlp/c_proj {kernel[L,F,E], bias[L,E]}
   ln_f {scale[E], bias[E]}
 The LM head is weight-tied to wte (reference :206) — no separate leaf.
+
+The merged QKV projection (reference my_gpt2.py:21 stores it as one [E, 3E]
+Conv1D) is kept as ONE kernel but shaped [L, E, 3, H, D] with explicit
+qkv/head axes: a single MXU matmul still computes all of q/k/v, while
+tensor parallelism can shard the HEAD axis — a contiguous split of the
+flat 3E dim would cross q/k/v boundaries and cost collective-permutes
+between the projection and attention.
 """
 
 from __future__ import annotations
@@ -45,15 +52,21 @@ Params = dict[str, Any]
 
 
 def _flash_kernel_active(
-    cfg: ModelConfig, t: int, seq_axis: str | None
+    cfg: ModelConfig,
+    t: int,
+    seq_axis: str | None,
+    deterministic: bool = True,
 ) -> bool:
     """True when attention will run the Pallas kernel, whose (o, l, m)
-    outputs the "names" remat policy saves directly."""
+    outputs the "names" remat policy saves directly. Mirrors every fallback
+    in ops/attention.multi_head_attention — including the attention-dropout
+    one (training with attn_pdrop>0 runs naive attention)."""
     from pytorch_distributed_tpu.ops.pallas_flash import _pallas_supported
 
     return (
         cfg.attention_impl == "flash"
         and seq_axis is None
+        and (deterministic or cfg.attn_pdrop == 0.0)
         and _pallas_supported(t, t, cfg.head_dim)
     )
 
@@ -62,7 +75,7 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     """GPT-2 initialisation (reference my_gpt2.py:216-244 distributions)."""
     pdt = jnp.dtype(cfg.param_dtype)
     e, l, v, c, f = cfg.n_embd, cfg.n_layer, cfg.vocab_size, cfg.n_ctx, cfg.inner_dim
-    q = 3 * e
+    h, d = cfg.n_head, cfg.head_dim
 
     keys = jax.random.split(key, 8)
 
@@ -79,8 +92,8 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
             "ln_1": ln((l, e)),
             "attn": {
                 "c_attn": {
-                    "kernel": normal(keys[2], (l, e, q), 0.02),
-                    "bias": jnp.zeros((l, q), pdt),
+                    "kernel": normal(keys[2], (l, e, 3, h, d), 0.02),
+                    "bias": jnp.zeros((l, 3, h, d), pdt),
                 },
                 "c_proj": {
                     "kernel": normal(keys[3], (l, e, e), 0.02),
@@ -124,11 +137,11 @@ def _block(
 
     # --- attention sub-block (reference my_gpt2.py:38-77, merged QKV :21) ---
     a = layer_norm(x, bp["ln_1"], eps=eps)
-    qkv = checkpoint_name(dense(a, bp["attn"]["c_attn"]), "qkv")  # [B, T, 3E]
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-    q = q.reshape(b, t, h, d)
-    k = k.reshape(b, t, h, d)
-    v = v.reshape(b, t, h, d)
+    # One matmul for q/k/v with explicit qkv/head kernel axes: under tensor
+    # parallelism the head axis is sharded and slicing the (replicated)
+    # qkv axis needs no resharding.
+    qkv = checkpoint_name(dense(a, bp["attn"]["c_attn"]), "qkv")  # [B,T,3,H,D]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     a = multi_head_attention(
         q, k, v,
         impl=cfg.attention_impl,
@@ -138,7 +151,7 @@ def _block(
         deterministic=deterministic,
         seq_axis=seq_axis,
     ).reshape(b, t, e)
-    if not _flash_kernel_active(cfg, t, seq_axis):
+    if not _flash_kernel_active(cfg, t, seq_axis, deterministic):
         # On the Pallas path the kernel's o output is already saved by the
         # remat policy (ops/remat._flash_call_policy); tagging here too would
         # store the same tensor twice (~12 MB/layer at bench shapes).

@@ -137,6 +137,13 @@ def _import_state_dict(
             f"c_attn kernel stacked shape {got} != {expect_qkv} — wrong "
             "layout? (use from_reference_state_dict for torch-Linear dicts)"
         )
+    # HF's flat [E, 3E] merged-QKV columns are [q(E) | k(E) | v(E)] with each
+    # E block laid out head-major — exactly our [E, 3, H, D] kernel flattened,
+    # so the reshape is a view, no permutation (models/gpt2.py layout note).
+    h, d = cfg.n_head, cfg.head_dim
+    attn = params["blocks"]["attn"]["c_attn"]
+    attn["kernel"] = attn["kernel"].reshape(cfg.n_layer, cfg.n_embd, 3, h, d)
+    attn["bias"] = attn["bias"].reshape(cfg.n_layer, 3, h, d)
     return params
 
 
@@ -161,6 +168,11 @@ def to_hf_gpt2_state_dict(params: dict) -> dict:
 
     for hf_key, path in _HF_BLOCK_KEYS.items():
         stacked = get(path)
+        if path[-2:] == ("c_attn", "kernel"):
+            # [L, E, 3, H, D] -> HF's flat [L, E, 3E] (inverse of import).
+            stacked = stacked.reshape(stacked.shape[0], stacked.shape[1], -1)
+        elif path[-2:] == ("c_attn", "bias"):
+            stacked = stacked.reshape(stacked.shape[0], -1)
         for layer in range(n_layer):
             out[f"h.{layer}.{hf_key}"] = stacked[layer]
     return out

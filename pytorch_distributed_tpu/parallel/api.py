@@ -34,7 +34,10 @@ from pytorch_distributed_tpu.parallel.mesh import (
     batch_partition_spec,
     make_batch_put,
 )
-from pytorch_distributed_tpu.parallel.sharding import state_shardings
+from pytorch_distributed_tpu.parallel.sharding import (
+    param_partition_specs,
+    state_shardings,
+)
 from pytorch_distributed_tpu.train.state import TrainState
 from pytorch_distributed_tpu.train.trainer import make_train_step
 
@@ -54,9 +57,28 @@ def make_parallel_train_step(
     [A, B_global, T] batch onto the mesh with the batch sharding (B split
     over data×fsdp axes, T over seq).
     """
-    base_step = make_train_step(model, model_cfg, tx, jit=False)
     shardings = state_shardings(state, mesh, mesh_cfg)
-    batch_sharding = NamedSharding(mesh, batch_partition_spec(mesh_cfg))
+    batch_spec = batch_partition_spec(mesh_cfg)  # P(None, batch_axes, seq)
+    # Logits [B, T, V]: batch/seq sharded like the inputs, vocab replicated.
+    logits_sharding = NamedSharding(
+        mesh, jax.sharding.PartitionSpec(batch_spec[1], batch_spec[2], None)
+    )
+    # Gradients follow the ZeRO level, not the param placement: under
+    # shard_grad_op params are replicated but grads reduce-scatter onto the
+    # optimizer-state shards.
+    grad_shardings = jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_partition_specs(state.params, mesh_cfg, for_grads=True),
+    )
+    base_step = make_train_step(
+        model,
+        model_cfg,
+        tx,
+        jit=False,
+        logits_sharding=logits_sharding,
+        grad_shardings=grad_shardings,
+    )
+    batch_sharding = NamedSharding(mesh, batch_spec)
     metrics_sharding = NamedSharding(mesh, jax.sharding.PartitionSpec())
 
     step = jax.jit(

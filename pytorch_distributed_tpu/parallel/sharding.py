@@ -39,9 +39,12 @@ from pytorch_distributed_tpu.train.state import TrainState
 # the only forward collective is one psum after c_proj/wo/down — XLA's SPMD
 # partitioner places it from these specs alone.
 _TENSOR_RULES: dict[tuple[str, ...], int] = {
-    # gpt2 (models/gpt2.py layout)
-    ("attn", "c_attn", "kernel"): 2,
-    ("attn", "c_attn", "bias"): 1,
+    # gpt2 (models/gpt2.py layout). The merged QKV kernel [L, E, 3, H, D]
+    # shards its HEAD dim (3) — head-aligned, so q/k/v slicing and attention
+    # run fully local (a flat-3E split would cross q/k/v boundaries and
+    # compile to collective-permutes between c_attn and attention).
+    ("attn", "c_attn", "kernel"): 3,
+    ("attn", "c_attn", "bias"): 2,
     ("attn", "c_proj", "kernel"): 1,
     ("mlp", "c_fc", "kernel"): 2,
     ("mlp", "c_fc", "bias"): 1,
@@ -122,27 +125,45 @@ def _leaf_spec(
     return P(*spec)
 
 
-def param_partition_specs(params, mesh_cfg: MeshConfig):
+def param_partition_specs(params, mesh_cfg: MeshConfig, *, for_grads=False):
     """PartitionSpec pytree for model params under the configured strategy.
 
     Tensor-parallel sharding (the "tensor" axis) applies under every FSDP
     strategy — TP is orthogonal to the ZeRO level. FSDP sharding of params
     applies only under full_shard.
 
+    ``for_grads=True`` returns the specs for the GRADIENT pytree instead:
+    gradients are fsdp-sharded under shard_grad_op too (ZeRO-2
+    reduce-scatters grads onto the shards that own the optimizer state,
+    while params stay replicated).
+
     Leaves under a top-level "blocks" key are layer-stacked [L, ...]; their
     leading dim is never sharded so scan-over-layers slices stay local and
     per-layer gathers (explicit FSDP) keep working.
     """
-    shard_fsdp = mesh_cfg.strategy == "full_shard"
+    if for_grads:
+        shard_fsdp = mesh_cfg.strategy in ("full_shard", "shard_grad_op")
+    else:
+        shard_fsdp = mesh_cfg.strategy == "full_shard"
 
     def spec_for(path, leaf):
-        stacked = bool(path) and getattr(path[0], "key", None) == "blocks"
+        keys = _path_keys(path)
+        stacked = bool(keys) and keys[0] == "blocks"
+        # Embedding tables ([V, E] wte / [C, E] wpe / [E, V] lm_head) shard
+        # the embedding dim only: vocab-sharding the tied wte makes the
+        # cross-entropy backward reshard batch-sharded dlogits to
+        # vocab-sharded (an all-to-all SPMD degrades to full
+        # rematerialisation), and vocab-parallel loss machinery is out of
+        # scope. min_dim=1 skips dim 0 (for lm_head [E, V] dim 1 IS E-free —
+        # but llama's untied head tolerates vocab sharding; keep it simple
+        # and uniform).
+        embedding = bool(keys) and keys[-1] in ("wte", "wpe")
         return _leaf_spec(
             tuple(leaf.shape),
             mesh_cfg,
             path=path,
             shard_fsdp=shard_fsdp,
-            min_dim=1 if stacked else 0,
+            min_dim=1 if (stacked or embedding) else 0,
         )
 
     return jax.tree_util.tree_map_with_path(spec_for, params)
@@ -161,13 +182,17 @@ def opt_state_partition_specs(opt_state, params_specs, mesh_cfg: MeshConfig):
         shape = tuple(getattr(leaf, "shape", ()))
         if not shape:
             return P()
-        stacked = any(getattr(p, "key", None) == "blocks" for p in path)
+        keys = _path_keys(path)
+        stacked = "blocks" in keys
+        # Moments mirror their params: embedding tables shard dim 1 only
+        # (see param_partition_specs).
+        embedding = bool(keys) and keys[-1] in ("wte", "wpe")
         return _leaf_spec(
             shape,
             mesh_cfg,
             path=path,
             shard_fsdp=shard_fsdp,
-            min_dim=1 if stacked else 0,
+            min_dim=1 if (stacked or embedding) else 0,
         )
 
     return jax.tree_util.tree_map_with_path(leaf_spec, opt_state)

@@ -48,18 +48,34 @@ def make_train_step(
     *,
     donate: bool = True,
     jit: bool = True,
+    logits_sharding=None,
+    grad_shardings=None,
 ) -> Callable:
     """Build the jitted (state, batch, dropout_key) -> (state, metrics) step.
 
     ``batch`` is a dict with "inputs"/"targets" of shape [A, B, T] where A is
     the accumulation factor (A=1 means no accumulation). Gradients are
     averaged over the A micro-batches before one optimizer update.
+
+    ``logits_sharding``/``grad_shardings`` (mesh runs only): sharding
+    constraints pinned on the [B, T, V] logits and the gradient pytree.
+    Without them XLA's SPMD partitioner can pick mismatched shardings for the
+    cross-entropy backward and the gradient accumulator under a tensor-
+    parallel mesh and fall back to "involuntary full rematerialization" —
+    replicating logits-sized tensors (see parallel/api.py, which passes both).
     """
     train_mode = (
         model_cfg.embd_pdrop > 0
         or model_cfg.attn_pdrop > 0
         or model_cfg.resid_pdrop > 0
     )
+
+    def constrain_grads(grads):
+        if grad_shardings is None:
+            return grads
+        return jax.tree.map(
+            jax.lax.with_sharding_constraint, grads, grad_shardings
+        )
 
     def micro_loss(params, inputs, targets, key):
         logits = model.apply(
@@ -69,6 +85,8 @@ def make_train_step(
             deterministic=not train_mode,
             dropout_key=key,
         )
+        if logits_sharding is not None:
+            logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
         return cross_entropy_loss(logits, targets)
 
     grad_fn = jax.value_and_grad(micro_loss)
@@ -85,6 +103,7 @@ def make_train_step(
                 batch["targets"][0],
                 jax.random.fold_in(dropout_key, 0),
             )
+            grads = constrain_grads(grads)
         else:
 
             def scan_body(carry, xs):
@@ -92,11 +111,15 @@ def make_train_step(
                 inputs, targets, idx = xs
                 key = jax.random.fold_in(dropout_key, idx)
                 loss, grads = grad_fn(state.params, inputs, targets, key)
-                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                grads_acc = constrain_grads(
+                    jax.tree.map(jnp.add, grads_acc, grads)
+                )
                 return (grads_acc, loss_acc + loss), None
 
-            zeros = jax.tree.map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            zeros = constrain_grads(
+                jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+                )
             )
             (grads, loss_sum), _ = jax.lax.scan(
                 scan_body,
@@ -222,11 +245,17 @@ class Trainer:
         start_step = int(jax.device_get(state.step))
 
         history: list[dict] = []
-        window_losses: list[float] = []
+        # Per-step losses stay ON DEVICE until a log boundary: a device_get
+        # every step would serialize dispatch (the host waits for step N
+        # before submitting N+1), which costs real throughput at small step
+        # times. The reference syncs per log interval in the same spirit
+        # (reference train/trainer.py:92-98). The step counter is tracked
+        # host-side for the same reason.
+        window_losses: list[jax.Array] = []
         t0 = time.perf_counter()
+        step = start_step
 
         for batch in self._grouped_batches(dataloader):
-            step = int(jax.device_get(state.step))
             if step >= num_steps:
                 break
             dkey = step_key(self._dropout_root, step)
@@ -240,16 +269,18 @@ class Trainer:
                     state, self._put_batch(batch), dkey
                 )
 
-            loss = float(jax.device_get(metrics["loss"]))
-            window_losses.append(loss)
-            new_step = step + 1
+            window_losses.append(metrics["loss"])
+            step = new_step = step + 1
 
             if profiler is not None:
                 profiler.step()
 
             if new_step % cfg.log_every_n_steps == 0 or new_step == num_steps:
+                losses = [
+                    float(x) for x in jax.device_get(window_losses)
+                ]  # single sync point for the whole window
                 elapsed = time.perf_counter() - t0
-                avg_loss = sum(window_losses) / len(window_losses)
+                avg_loss = sum(losses) / len(losses)
                 lr = lr_at_step(cfg, new_step)
                 self._log(
                     f"step {new_step}/{num_steps} | loss {avg_loss:.4f} | "

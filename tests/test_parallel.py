@@ -111,7 +111,10 @@ def test_parallel_matches_single_device(setup, strategy, data, fsdp, seq, path):
 
 TP_CONFIGS = [
     # (strategy, data, fsdp, tensor): TP alone, TP x DP, TP x FSDP.
-    ("no_shard", 1, 1, 8),
+    # tensor must divide n_head (=4): head-aligned QKV sharding is the point
+    # (a flat-3E split crossing q/k/v boundaries compiles to extra
+    # collective-permutes between c_attn and attention).
+    ("no_shard", 1, 1, 4),
     ("no_shard", 2, 1, 4),
     ("full_shard", 1, 2, 4),
 ]
@@ -184,13 +187,16 @@ def test_tensor_parallel_param_placement(setup, eight_devices):
     over "tensor"; row-parallel projections shard their input dim; LN and
     embeddings stay replicated over tensor."""
     cfg, model = setup["cfg"], setup["model"]
-    mcfg = MeshConfig(tensor=8, strategy="no_shard")
+    mcfg = MeshConfig(tensor=4, strategy="no_shard")
     specs = param_partition_specs(
         model.init(domain_key(42, "init"), cfg), mcfg
     )
     blocks = specs["blocks"]
-    assert blocks["attn"]["c_attn"]["kernel"] == P(None, None, "tensor")
-    assert blocks["attn"]["c_attn"]["bias"] == P(None, "tensor")
+    # c_attn [L, E, 3, H, D] shards the HEAD axis (head-aligned TP).
+    assert blocks["attn"]["c_attn"]["kernel"] == P(
+        None, None, None, "tensor", None
+    )
+    assert blocks["attn"]["c_attn"]["bias"] == P(None, None, "tensor", None)
     assert blocks["attn"]["c_proj"]["kernel"] == P(None, "tensor", None)
     assert blocks["mlp"]["c_fc"]["kernel"] == P(None, None, "tensor")
     assert blocks["mlp"]["c_proj"]["kernel"] == P(None, "tensor", None)
@@ -202,9 +208,11 @@ def test_tensor_parallel_param_placement(setup, eight_devices):
         model.init(domain_key(42, "init"), cfg), mcfg2
     )
     assert specs2["blocks"]["attn"]["c_attn"]["kernel"] == P(
-        None, "fsdp", "tensor"
+        None, "fsdp", None, "tensor", None
     )
-    assert specs2["wte"] == P("fsdp", None)
+    # Embedding tables shard the embedding dim, never vocab (tied-head
+    # backward degrades to full rematerialisation on vocab-sharded wte).
+    assert specs2["wte"] == P(None, "fsdp")
 
 
 def test_full_shard_actually_shards_state(setup, eight_devices):
@@ -214,12 +222,12 @@ def test_full_shard_actually_shards_state(setup, eight_devices):
     mesh = make_mesh(mcfg)
     state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
     state, _ = shard_train_state(state, mesh, mcfg)
-    # wte [128, 64]: sharded over rows -> each shard 16 rows.
+    # wte [128, 64]: sharded over the embedding dim -> each shard 8 cols.
     wte = state.params["wte"]
     shard_shapes = {
         tuple(s.data.shape) for s in wte.addressable_shards
     }
-    assert shard_shapes == {(16, 64)}
+    assert shard_shapes == {(128, 8)}
     # Stacked block leaves never shard the layer dim.
     specs = param_partition_specs(state.params, mcfg)
     for spec in jax.tree.leaves(
