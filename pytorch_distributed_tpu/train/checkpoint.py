@@ -5,10 +5,25 @@ Capability twin of reference train/trainer.py:117-141 (torch.save/load of
 whole TrainState pytree ({params, opt_state, step} — the LR schedule is a
 pure function of step, so it needs no separate state).
 
-Format: one ``.npz`` with flattened leaves keyed by their tree path, plus a
-``meta.json`` sidecar with the structure and metadata. Self-contained numpy —
-readable without JAX — and path-keyed, so checkpoints survive refactors that
-reorder (but not rename) the tree. Save is atomic (write temp dir, rename).
+Two formats behind one API (``save_checkpoint``/``load_checkpoint`` pick by
+what the state needs; ``format=`` overrides):
+
+- ``npz``: one ``.npz`` with flattened leaves keyed by their tree path plus a
+  ``meta.json`` sidecar. Self-contained numpy — readable without JAX — and
+  path-keyed, so checkpoints survive refactors that reorder (but not rename)
+  the tree. Save is atomic (write temp dir, rename). SINGLE-HOST ONLY: it
+  device_gets every leaf, which throws on a pod where sharded leaves are not
+  fully addressable from one process.
+- ``orbax``: tensorstore/OCDBT via orbax — every process writes exactly its
+  addressable shards and restore places shards directly onto the target
+  shardings (the idiomatic multi-host path, SURVEY.md §5.4; the reference's
+  rank-0 torch.save, distributed_trainer.py:214-221, is naive here). Used
+  automatically when any leaf is not fully addressable.
+
+``load_checkpoint`` restores into the structure AND shardings of the template
+pytree: leaves come back as jax.Arrays placed like the template's (the
+reference's ``map_location=model.device``, trainer.py:139, generalised to
+shardings).
 """
 
 from __future__ import annotations
@@ -38,13 +53,40 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+def _fully_addressable(state: Any) -> bool:
+    for leaf in jax.tree.leaves(state):
+        if (
+            isinstance(leaf, jax.Array)
+            and not leaf.is_fully_addressable
+        ):
+            return False
+    return True
+
+
 def save_checkpoint(
-    directory: str | Path, state: Any, *, metadata: dict | None = None
+    directory: str | Path,
+    state: Any,
+    *,
+    metadata: dict | None = None,
+    format: str = "auto",
 ) -> str:
-    """Serialise a pytree of arrays. Only the calling process writes
-    (callers gate on process 0, reference distributed_trainer.py:214-221)."""
+    """Serialise a pytree of arrays.
+
+    format="auto" picks npz when every leaf is addressable from this process
+    (single host) and orbax otherwise. npz writes from the calling process
+    only (callers gate on process 0, reference distributed_trainer.py:214-221);
+    orbax saves are collective — EVERY process must call this, each writes
+    its own shards.
+    """
+    if format == "auto":
+        format = "npz" if _fully_addressable(state) else "orbax"
+    if format == "orbax":
+        return _save_orbax(directory, state, metadata=metadata)
+    if format != "npz":
+        raise ValueError(f"unknown checkpoint format {format!r}")
+
     directory = Path(directory)
-    os.makedirs(directory.parent if directory.suffix else directory.parent, exist_ok=True)
+    os.makedirs(directory.parent, exist_ok=True)
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
     arrays = {}
     for path, leaf in leaves_with_paths:
@@ -68,11 +110,45 @@ def save_checkpoint(
     return str(directory)
 
 
+def _save_orbax(
+    directory: str | Path, state: Any, *, metadata: dict | None = None
+) -> str:
+    import orbax.checkpoint as ocp
+
+    directory = Path(directory).resolve()
+    # Write into a deterministic sibling temp dir (same name on every
+    # process), then swap. Orbax's collective save is itself atomic into the
+    # temp location and returns only once all processes have committed, so
+    # the previous checkpoint is deleted only AFTER the new one is complete
+    # — a crash in the swap window leaves the new data recoverable at the
+    # temp path rather than destroying both.
+    tmp = directory.parent / (".tmp_" + directory.name)
+    if jax.process_index() == 0 and tmp.exists():
+        shutil.rmtree(tmp)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(tmp / "tree", state)
+    if jax.process_index() == 0:
+        (tmp / "meta.json").write_text(
+            json.dumps(
+                {"format": "pdtpu-ckpt-orbax-v1", "metadata": metadata or {}},
+                indent=1,
+            )
+        )
+        if directory.exists():
+            shutil.rmtree(directory)
+        os.replace(tmp, directory)
+    return str(directory)
+
+
 def load_checkpoint(directory: str | Path, like: Any) -> Any:
-    """Restore into the structure of ``like`` (a template pytree, e.g. a
-    freshly initialised TrainState — the analogue of load_state_dict
-    restoring into constructed modules, reference trainer.py:130-141)."""
+    """Restore into the structure AND shardings of ``like`` (a template
+    pytree, e.g. a freshly initialised — possibly sharded — TrainState; the
+    analogue of load_state_dict restoring into constructed modules,
+    reference trainer.py:130-141, with map_location generalised to
+    shardings)."""
     directory = Path(directory)
+    if (directory / "tree").exists():
+        return _load_orbax(directory, like)
     with np.load(directory / "arrays.npz") as data:
         arrays = {k: data[k] for k in data.files}
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
@@ -90,8 +166,35 @@ def load_checkpoint(directory: str | Path, like: Any) -> Any:
             raise ValueError(
                 f"checkpoint leaf {key!r} shape {got.shape} != expected {want_shape}"
             )
-        new_leaves.append(got.astype(leaf.dtype))
+        restored = got.astype(leaf.dtype)
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            # Re-apply the template's placement (sharded restore).
+            restored = jax.device_put(restored, leaf.sharding)
+        new_leaves.append(restored)
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _load_orbax(directory: str | Path, like: Any) -> Any:
+    import orbax.checkpoint as ocp
+
+    def abstract(leaf):
+        if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=leaf.sharding
+            )
+        return leaf
+
+    template = jax.tree.map(abstract, like)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(
+            Path(directory).resolve() / "tree",
+            ocp.args.PyTreeRestore(
+                template,
+                restore_args=ocp.checkpoint_utils.construct_restore_args(
+                    template
+                ),
+            ),
+        )
 
 
 def read_metadata(directory: str | Path) -> dict:

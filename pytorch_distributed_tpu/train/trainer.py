@@ -302,7 +302,4 @@ class Trainer:
             ):
                 self.save_checkpoint(state)
 
-            if new_step - start_step >= num_steps:
-                break
-
         return state, history
