@@ -114,9 +114,21 @@ def ring_attention(
         return (kb, vb, acc), None
 
     # Accumulators hold device-varying values; mark them so under shard_map's
-    # varying-manual-axes typing (constants start out unvarying).
+    # varying-manual-axes typing (constants start out unvarying). They must
+    # vary on every axis the INPUTS vary on (not just the ring axis — the
+    # batch dim is typically sharded over data/fsdp axes too), or the
+    # lax.cond/scan branches disagree on types.
+    target_vma = frozenset().union(
+        *(getattr(jax.typeof(a), "vma", frozenset()) for a in (q, k, v))
+    ) | {axis_name}
+
     def varying(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        need = tuple(
+            ax
+            for ax in target_vma
+            if ax not in getattr(jax.typeof(x), "vma", frozenset())
+        )
+        return jax.lax.pcast(x, need, to="varying") if need else x
 
     acc0 = (
         varying(jnp.zeros((b, h, tl, d), jnp.float32)),
@@ -127,7 +139,20 @@ def ring_attention(
     (kb, vb, acc), _ = jax.lax.scan(
         ring_step, (k, v, acc0), jnp.arange(n - 1)
     )
-    o, m, l = accumulate(acc, kb, vb, n - 1)
+    if causal:
+        # Same skip as in ring_step: the final block (src = (idx+1) mod n)
+        # is fully masked for every shard except idx = n-1 — without the
+        # guard, n-1 of n devices pay its QK^T and PV matmuls for a zero
+        # contribution.
+        src = (idx - (n - 1)) % n
+        o, m, l = jax.lax.cond(
+            src <= idx,
+            lambda a: accumulate(a, kb, vb, n - 1),
+            lambda a: a,
+            acc,
+        )
+    else:
+        o, m, l = accumulate(acc, kb, vb, n - 1)
 
     out = o / l[..., None]
     return out.transpose(0, 2, 1, 3).astype(v.dtype)

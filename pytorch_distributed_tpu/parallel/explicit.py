@@ -191,26 +191,48 @@ def make_explicit_train_step(
 
     grad_fn = jax.value_and_grad(forward_loss)
 
+    # Axes along which per-shard values actually vary (sharded batch and/or
+    # sharded params). Fresh constants (the scan's zero accumulators) start
+    # typed as unvarying under check_vma; they must be pcast to match the
+    # varying gradients/losses the scan body produces.
+    vary_axes = tuple(
+        ax for ax in ("data", "fsdp", "seq") if getattr(mesh_cfg, ax) > 1
+    )
+
+    def _vary(x):
+        have = getattr(getattr(x, "aval", None), "vma", frozenset())
+        need = tuple(ax for ax in vary_axes if ax not in have)
+        return jax.lax.pcast(x, need, to="varying") if need else x
+
     def step_impl(state: TrainState, batch: dict, dropout_key: jax.Array):
         accum = batch["inputs"].shape[0]
+
+        # Differentiate w.r.t. params CAST TO VARYING: if params stayed typed
+        # as invariant, vma-aware AD would insert an automatic psum into the
+        # transpose at every micro-batch — both defeating the no_sync
+        # semantics (communication deferred to the boundary) and
+        # double-counting with the explicit pmean below. With varying params
+        # AD produces the per-shard local gradient and every collective in
+        # this step is one written by hand.
+        vparams = jax.tree.map(_vary, state.params)
 
         # --- local gradient accumulation: NO collectives inside ----------
         def scan_body(carry, xs):
             grads_acc, loss_acc = carry
             inputs, targets, idx = xs
             key = jax.random.fold_in(dropout_key, idx)
-            loss, grads = grad_fn(state.params, inputs, targets, key)
+            loss, grads = grad_fn(vparams, inputs, targets, key)
             return (
                 jax.tree.map(jnp.add, grads_acc, grads),
                 loss_acc + loss,
             ), None
 
         zeros = jax.tree.map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            lambda p: _vary(jnp.zeros(p.shape, jnp.float32)), state.params
         )
         (grads, loss_sum), _ = jax.lax.scan(
             scan_body,
-            (zeros, jnp.zeros((), jnp.float32)),
+            (zeros, _vary(jnp.zeros((), jnp.float32))),
             (batch["inputs"], batch["targets"], jnp.arange(accum)),
         )
         grads = jax.tree.map(lambda g: g / accum, grads)
@@ -258,7 +280,10 @@ def make_explicit_train_step(
                 grads, state.opt_state, params_shard
             )
             new_params_shard = optax.apply_updates(params_shard, updates)
-            new_params = _gather_params(new_params_shard, shard_specs)
+            new_params = jax.tree.map(
+                lambda s, full, spec: _unscatter(s, full, spec),
+                new_params_shard, state.params, shard_specs,
+            )
         else:
             updates, new_opt_state = tx.update(
                 grads, state.opt_state, state.params
@@ -291,10 +316,11 @@ def make_explicit_train_step(
             TrainState(params=p_specs, opt_state=o_specs, step=P()),
             {"loss": P(), "grad_norm": P()},
         ),
-        # Collectives make per-shard values replicated again; skip the
-        # varying-manual-axes bookkeeping (equivalence with the single-device
-        # step is asserted numerically in tests instead).
-        check_vma=False,
+        # Varying-manual-axes typing ON: a future edit that breaks a
+        # replication invariant (e.g. returning a per-shard value through a
+        # P() out_spec) fails at trace time instead of silently producing
+        # wrong numbers.
+        check_vma=True,
     )
     return jax.jit(smapped, donate_argnums=(0,))
 
@@ -307,3 +333,22 @@ def _shard_slice(full, spec: P, fsdp_size: int):
     idx = jax.lax.axis_index("fsdp")
     size = full.shape[dim] // fsdp_size
     return jax.lax.dynamic_slice_in_dim(full, idx * size, size, axis=dim)
+
+
+def _unscatter(shard, full_like, spec: P):
+    """Rebuild the full replicated array from disjoint per-device shards
+    (inverse of ``_shard_slice``): pad to full size at this device's slice
+    and psum over "fsdp". Numerically identical to all_gather of the shards,
+    but typed INVARIANT over fsdp by the varying-manual-axes system —
+    all_gather output stays typed varying, which would fail the replicated
+    out_specs under check_vma. (Bandwidth 2x an all_gather; the teaching
+    path trades that for a machine-checked replication invariant.)"""
+    dim = _sharded_dim(spec)
+    if dim is None:
+        return shard
+    idx = jax.lax.axis_index("fsdp")
+    size = shard.shape[dim]
+    padded = jax.lax.dynamic_update_slice_in_dim(
+        jnp.zeros(full_like.shape, shard.dtype), shard, idx * size, axis=dim
+    )
+    return jax.lax.psum(padded, "fsdp")
