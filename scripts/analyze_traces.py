@@ -8,9 +8,14 @@ communication hidden-vs-exposed overlap, and for each requested pair
 (c) the operator diff filtered to collectives — the notebook's
 baseline<->DDP, DDP<->FSDP comparisons.
 
+With ``--charts DIR`` it also renders the notebook's figures as PNGs: a
+temporal-breakdown pie per trace (the notebook's pie charts) plus a top-ops
+bar chart.
+
 Examples:
   python scripts/analyze_traces.py outputs/traces/baseline outputs/traces/ddp
   python scripts/analyze_traces.py outputs/traces/ddp outputs/traces/fsdp_full_shard --all-ops
+  python scripts/analyze_traces.py outputs/traces/baseline --charts outputs/charts
 """
 
 import argparse
@@ -27,6 +32,54 @@ def _latest_trace(d: str) -> str | None:
     return files[-1] if files else None
 
 
+def _write_charts(outdir: str, label: str, trace, tb: dict, *, top: int):
+    """Notebook-parity figures (reference analyze_traces.ipynb renders a
+    temporal-breakdown pie per run): one pie + one top-ops bar per trace."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    from pytorch_distributed_tpu.profiling.trace_analysis import op_summary
+
+    out = Path(outdir)
+    out.mkdir(parents=True, exist_ok=True)
+    # Use the last two path components so runA/traces and runB/traces don't
+    # silently overwrite each other's figures.
+    parts = [p for p in Path(label).parts if p not in (".", "/")]
+    stem = "_".join(parts[-2:]) if parts else "trace"
+
+    parts = {
+        "compute": tb["compute_pct"],
+        "communication": tb["communication_pct"],
+        "memcpy": tb["memcpy_pct"],
+        "idle": tb["idle_pct"],
+    }
+    parts = {k: v for k, v in parts.items() if v > 0.05}
+    if parts:
+        fig, ax = plt.subplots(figsize=(5, 5))
+        ax.pie(parts.values(), labels=list(parts),
+               autopct="%1.1f%%", startangle=90)
+        ax.set_title(f"temporal breakdown — {stem}")
+        fig.savefig(out / f"{stem}_temporal_pie.png",
+                    dpi=120, bbox_inches="tight")
+        plt.close(fig)
+
+    ops = sorted(op_summary(trace).items(),
+                 key=lambda kv: -kv[1]["total_us"])[:top]
+    if ops:
+        names = [n[:48] for n, _ in ops][::-1]
+        vals = [v["total_us"] / 1e3 for _, v in ops][::-1]
+        fig, ax = plt.subplots(figsize=(8, 0.35 * len(names) + 1.2))
+        ax.barh(names, vals)
+        ax.set_xlabel("total device time (ms)")
+        ax.set_title(f"top {len(names)} ops — {stem}")
+        fig.savefig(out / f"{stem}_top_ops.png",
+                    dpi=120, bbox_inches="tight")
+        plt.close(fig)
+    print(f"  charts -> {out}/{stem}_*.png")
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("trace_dirs", nargs="+",
@@ -34,6 +87,8 @@ def main() -> int:
     p.add_argument("--all-ops", action="store_true",
                    help="diff all ops, not just collectives")
     p.add_argument("--top", type=int, default=15)
+    p.add_argument("--charts", metavar="DIR", default=None,
+                   help="also write PNG charts (pie + top-ops bar) here")
     args = p.parse_args()
 
     from pytorch_distributed_tpu.profiling.trace_analysis import (
@@ -67,6 +122,8 @@ def main() -> int:
             f"hidden {ov['overlap_pct']:.1f}%, "
             f"exposed {ov['exposed_pct']:.1f}%"
         )
+        if args.charts:
+            _write_charts(args.charts, d, traces[d], tb, top=args.top)
 
     names = list(traces)
     for i in range(len(names) - 1):

@@ -1,0 +1,334 @@
+"""BASELINE.md benchmark suite: configs 1-5, DDP vs FSDP, tokens/s/chip + MFU.
+
+Produces ``benchmarks/results.json`` and ``benchmarks/RESULTS.md`` (the
+results table the reference's run matrix implies but never commits —
+reference assignments/assignment1/README.md:33-49, BASELINE.md configs 1-5).
+
+Two kinds of rows:
+
+- measured: run on the real accelerator with the hardened bench.py
+  methodology (median of several windows, fresh seed). Configs that fit one
+  chip: GPT-2 124M (f32 master weights) and GPT-2 1.3B / Llama-3 1B with
+  bf16 optimizer state (f32 state for a 1B-param model exceeds one v5e's
+  16 GB HBM; noted in the row).
+- correctness-only: multi-chip parallelism configs executed on an 8-virtual-
+  device CPU mesh at reduced dimensions (the cluster-free contract,
+  SURVEY.md §4). These validate the parallelism wiring (DDP/FSDP/TP loss
+  finiteness + step completion) and are clearly marked — tokens/s on a CPU
+  mesh is meaningless.
+
+Usage:
+  python scripts/bench_suite.py                 # all rows
+  python scripts/bench_suite.py --rows 1,3      # subset
+  python scripts/bench_suite.py --no-virtual    # measured rows only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Row definitions (BASELINE.md "Configs to benchmark").
+ROWS = {
+    1: dict(
+        name="gpt2-124M single-chip",
+        preset="gpt2",
+        parallelism="none",
+        measured=True,
+        batch=8,
+        param_dtype="float32",
+    ),
+    2: dict(
+        name="gpt2-124M DP x8 (DDP equivalent)",
+        preset="gpt2",
+        parallelism="dp8",
+        measured=False,
+        mesh=dict(data=8, strategy="no_shard"),
+    ),
+    3: dict(
+        name="gpt2-1.3B FSDP full-shard x8 (ZeRO-3)",
+        preset="gpt2-1p3b",
+        parallelism="fsdp8",
+        measured=True,  # single-chip proxy with bf16 state + virtual-mesh correctness
+        batch=4,
+        param_dtype="bfloat16",
+        mesh=dict(fsdp=8, strategy="full_shard"),
+    ),
+    4: dict(
+        name="llama3-1B FSDP + bf16",
+        preset="llama3-1b",
+        parallelism="fsdp8",
+        measured=True,
+        batch=4,
+        param_dtype="bfloat16",
+        mesh=dict(fsdp=8, strategy="full_shard"),
+    ),
+    5: dict(
+        name="llama3-8B FSDP + activation ckpt",
+        preset="llama3-8b",
+        parallelism="fsdp8",
+        measured=False,  # 8B does not fit one chip in any dtype
+        mesh=dict(fsdp=8, strategy="full_shard"),
+    ),
+}
+
+V5E_PEAK_BF16 = 197e12
+
+
+def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
+    """Single-chip measured throughput, bench.py methodology."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.config import TrainConfig, model_config
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.train.trainer import make_train_step
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    seed = int.from_bytes(os.urandom(4), "little")
+    B, T = row["batch"], 1024
+    cfg = model_config(
+        row["preset"], dtype="bfloat16", param_dtype=row["param_dtype"]
+    ).replace(
+        attention_impl="flash",
+        remat="names",
+        logits_dtype="bfloat16",
+        embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+        n_ctx=1024,  # benchmark sequence length (llama presets default 8192)
+    )
+    model = get_model(cfg)
+    tcfg = TrainConfig(
+        global_batch_size=B, micro_batch_size=B,
+        num_steps=3 + windows * window_steps, learning_rate=3e-4,
+    )
+    tx = make_optimizer(tcfg)
+    params = model.init(domain_key(seed, "init"), cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    state = init_train_state(params, tx)
+    step = make_train_step(model, cfg, tx)
+    rng = np.random.default_rng(seed)
+    batch = {
+        "inputs": jax.numpy.asarray(
+            rng.integers(0, cfg.vocab_size, (1, B, T)), dtype=jax.numpy.int32
+        ),
+        "targets": jax.numpy.asarray(
+            rng.integers(0, cfg.vocab_size, (1, B, T)), dtype=jax.numpy.int32
+        ),
+    }
+    dkey = domain_key(seed, "dropout")
+    idx = 0
+    for _ in range(3):
+        state, m = step(state, batch, jax.random.fold_in(dkey, idx))
+        idx += 1
+    float(jax.device_get(m["loss"]))
+
+    tps = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(window_steps):
+            state, m = step(state, batch, jax.random.fold_in(dkey, idx))
+            idx += 1
+        loss = float(jax.device_get(m["loss"]))
+        tps.append(window_steps * B * T / (time.perf_counter() - t0))
+
+    tok_s = statistics.median(tps)
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * T
+    mfu = tok_s * flops_per_token / V5E_PEAK_BF16
+    return dict(
+        kind="measured",
+        platform=jax.devices()[0].platform,
+        n_params=n_params,
+        batch=B, seq_len=T,
+        tokens_per_sec_per_chip=round(tok_s, 1),
+        ms_per_step=round(B * T / tok_s * 1e3, 1),
+        mfu_pct=round(mfu * 100, 1),
+        window_spread=round(max(tps) / min(tps), 3),
+        final_loss=round(loss, 3),
+        note=(
+            "bf16 optimizer state (f32 state for ~1B params exceeds one "
+            "chip's HBM)" if row["param_dtype"] == "bfloat16" else ""
+        ),
+    )
+
+
+def virtual_row_main(row_id: int) -> None:
+    """Child-process entry: correctness-only run on an 8-virtual-device CPU
+    mesh at reduced dimensions. Prints one JSON line."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from pytorch_distributed_tpu.config import (
+        MeshConfig, TrainConfig, model_config,
+    )
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import (
+        make_mesh, make_parallel_train_step, shard_train_state,
+    )
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+
+    row = ROWS[row_id]
+    scaled = dict(n_layer=2, n_ctx=256, vocab_size=1024)
+    cfg = model_config(row["preset"], dtype="float32").replace(
+        embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0, remat="names",
+        **scaled,
+    )
+    model = get_model(cfg)
+    mesh_cfg = MeshConfig(**row["mesh"])
+    mesh = make_mesh(mesh_cfg)
+    B, T = 8, 64
+    tcfg = TrainConfig(
+        global_batch_size=2 * B, micro_batch_size=1,
+        num_steps=2, learning_rate=1e-3,
+    )
+    tx = make_optimizer(tcfg)
+    state = init_train_state(model.init(jax.random.key(0), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mesh_cfg)
+    step, put = make_parallel_train_step(model, cfg, tx, mesh, mesh_cfg, state)
+    rng = np.random.default_rng(0)
+    batch = put({
+        "inputs": rng.integers(0, cfg.vocab_size, (2, B, T)).astype(np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (2, B, T)).astype(np.int32),
+    })
+    losses = []
+    for i in range(2):
+        state, m = step(state, batch, jax.random.key(i))
+        losses.append(float(jax.device_get(m["loss"])))
+    assert all(np.isfinite(losses)), losses
+    assert int(jax.device_get(state.step)) == 2
+    print(json.dumps(dict(
+        kind="correctness_only",
+        platform="cpu-virtual-8dev",
+        mesh=row["mesh"],
+        scaled_dims=dict(**scaled, batch=2 * B, seq_len=T),
+        losses=[round(x, 4) for x in losses],
+        note=(
+            "parallelism wiring validated on a virtual CPU mesh at reduced "
+            "dimensions; throughput not meaningful without real chips"
+        ),
+    )))
+
+
+def run_virtual_subprocess(row_id: int) -> dict:
+    env = dict(os.environ)
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, __file__, "--virtual-row", str(row_id)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=1200,
+    )
+    if proc.returncode != 0:
+        return dict(kind="correctness_only", ok=False,
+                    error=proc.stderr.strip().splitlines()[-5:])
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def write_artifacts(results: dict) -> None:
+    outdir = REPO / "benchmarks"
+    outdir.mkdir(exist_ok=True)
+    (outdir / "results.json").write_text(json.dumps(results, indent=1))
+
+    lines = [
+        "# Benchmark results (BASELINE.md configs 1-5)",
+        "",
+        f"Generated by `scripts/bench_suite.py`. "
+        f"Measured rows: real accelerator, median of timed windows "
+        f"(bench.py methodology). Correctness-only rows: 8-virtual-device "
+        f"CPU mesh at reduced dims — parallelism wiring only.",
+        "",
+        "| # | Config | Parallelism | tok/s/chip | ms/step | MFU | Status |",
+        "|---|--------|-------------|-----------:|--------:|----:|--------|",
+    ]
+    for rid, res in sorted(results["rows"].items(), key=lambda kv: int(kv[0])):
+        row = ROWS[int(rid)]
+        if res.get("kind") == "measured":
+            lines.append(
+                f"| {rid} | {row['name']} | {row['parallelism']} | "
+                f"{res['tokens_per_sec_per_chip']:,.0f} | "
+                f"{res['ms_per_step']} | {res['mfu_pct']}% | measured "
+                f"({res.get('note') or 'real chip'}) |"
+            )
+        else:
+            status = (
+                "correctness-only (virtual CPU mesh)"
+                if res.get("losses") or res.get("ok", True)
+                else f"FAILED: {res.get('error')}"
+            )
+            lines.append(
+                f"| {rid} | {row['name']} | {row['parallelism']} | "
+                f"n/a | n/a | n/a | {status} |"
+            )
+        extra = results.get("virtual", {}).get(str(rid))
+        if extra and res.get("kind") == "measured":
+            lines.append(
+                f"| {rid}v | {row['name']} (mesh wiring) | "
+                f"{extra.get('mesh')} | n/a | n/a | n/a | "
+                f"correctness-only (virtual CPU mesh) |"
+            )
+    lines += [
+        "",
+        "Notes:",
+        "- MFU = tok/s x (6N + 12·L·E·T) / 197e12 (v5e bf16 peak).",
+        "- All measured rows: T=1024, bf16 activations, Pallas flash "
+        "attention, named-saves remat, bf16 logits, no dropout.",
+        "- ~1B-param rows use bf16 optimizer state to fit one chip's HBM; "
+        "multi-chip f32-state runs are what the mesh configs are for.",
+    ]
+    (outdir / "RESULTS.md").write_text("\n".join(lines) + "\n")
+    print(f"wrote {outdir / 'results.json'} and {outdir / 'RESULTS.md'}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", default="1,2,3,4,5")
+    ap.add_argument("--windows", type=int, default=2)
+    ap.add_argument("--window-steps", type=int, default=6)
+    ap.add_argument("--no-virtual", action="store_true")
+    ap.add_argument("--virtual-row", type=int, default=None,
+                    help=argparse.SUPPRESS)  # child-process entry
+    args = ap.parse_args()
+
+    if args.virtual_row is not None:
+        virtual_row_main(args.virtual_row)
+        return
+
+    row_ids = [int(r) for r in args.rows.split(",")]
+    results: dict = {"rows": {}, "virtual": {}}
+    for rid in row_ids:
+        row = ROWS[rid]
+        if row["measured"]:
+            print(f"[row {rid}] measuring {row['name']} ...", file=sys.stderr)
+            results["rows"][str(rid)] = measure_row(
+                row, windows=args.windows, window_steps=args.window_steps
+            )
+            if row.get("mesh") and not args.no_virtual:
+                print(f"[row {rid}] virtual-mesh wiring check ...",
+                      file=sys.stderr)
+                results["virtual"][str(rid)] = run_virtual_subprocess(rid)
+        elif not args.no_virtual:
+            print(f"[row {rid}] correctness-only {row['name']} ...",
+                  file=sys.stderr)
+            results["rows"][str(rid)] = run_virtual_subprocess(rid)
+    write_artifacts(results)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(REPO))
+    main()
