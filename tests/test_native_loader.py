@@ -1,0 +1,91 @@
+"""Native (C++) loader: batch-for-batch parity with the numpy loaders.
+
+The native loader implements the distributed lockstep stream, so its oracle
+is ``DistributedTokenShardLoader`` — including world=1. Skips cleanly when no
+C++ toolchain is available.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.data import bin_format
+from pytorch_distributed_tpu.data.distributed_loader import (
+    DistributedTokenShardLoader,
+)
+
+native = pytest.importorskip(
+    "pytorch_distributed_tpu.data.native_loader"
+)
+
+try:
+    native._load_library()
+except native.NativeLoaderUnavailable as e:  # pragma: no cover
+    pytest.skip(f"native loader unavailable: {e}", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def shards(tmp_path_factory):
+    root = tmp_path_factory.mktemp("shards")
+    rng = np.random.default_rng(0)
+    paths = []
+    for i, count in enumerate([977, 1251, 613]):  # ragged sizes on purpose
+        p = root / f"shard_{i:03d}.bin"
+        bin_format.write_shard(p, rng.integers(0, 5000, count).astype(np.uint16))
+        paths.append(p)
+    return paths
+
+
+@pytest.mark.parametrize("world", [1, 4])
+def test_matches_numpy_distributed_loader(shards, world):
+    b, t = 2, 8
+    for rank in range(world):
+        ref = DistributedTokenShardLoader(
+            shards, b, t, rank=rank, world_size=world
+        )
+        nat = native.NativeTokenShardLoader(
+            shards, b, t, rank=rank, world_size=world
+        )
+        ref_batches = list(ref)
+        nat_batches = list(nat)
+        assert len(ref_batches) == len(nat_batches) > 0
+        for (ri, rt), (ni, nt) in zip(ref_batches, nat_batches):
+            np.testing.assert_array_equal(ri, ni)
+            np.testing.assert_array_equal(rt, nt)
+
+
+def test_reiteration_restarts(shards):
+    nat = native.NativeTokenShardLoader(shards, 2, 8)
+    first = [i.copy() for i, _ in nat]
+    second = [i.copy() for i, _ in nat]
+    assert len(first) == len(second)
+    for a, b_ in zip(first, second):
+        np.testing.assert_array_equal(a, b_)
+
+
+def test_prefetch_depth_and_info(shards):
+    nat = native.NativeTokenShardLoader(
+        shards, 2, 8, prefetch_depth=4
+    )
+    n = sum(1 for _ in nat)
+    assert n > 0
+    info = nat.get_info()
+    assert info["backend"].startswith("native")
+    assert info["total_tokens"] == 977 + 1251 + 613
+
+
+def test_corrupt_shard_raises(tmp_path):
+    p = tmp_path / "bad.bin"
+    good = np.zeros(300, dtype=np.uint16)
+    bin_format.write_shard(p, good)
+    raw = bytearray(p.read_bytes())
+    raw[4] = 9  # version byte
+    p.write_bytes(bytes(raw))
+    with pytest.raises(bin_format.ShardFormatError):
+        native.NativeTokenShardLoader([p], 2, 8)
+
+
+def test_empty_file_list_raises():
+    with pytest.raises(ValueError):
+        native.NativeTokenShardLoader([], 2, 8)
