@@ -86,6 +86,12 @@ def save_checkpoint(
         raise ValueError(f"unknown checkpoint format {format!r}")
 
     directory = Path(directory)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # npz is a single-writer format; non-zero processes only wait at the
+        # barrier so no one races ahead of the write (callers may call this
+        # from every process — required for the collective orbax format).
+        _sync("pdtpu:ckpt:npz")
+        return str(directory)
     os.makedirs(directory.parent, exist_ok=True)
     leaves_with_paths = jax.tree_util.tree_flatten_with_path(state)[0]
     arrays = {}
@@ -107,7 +113,16 @@ def save_checkpoint(
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
+    if jax.process_count() > 1:
+        _sync("pdtpu:ckpt:npz")
     return str(directory)
+
+
+def _sync(tag: str) -> None:
+    """Cross-process barrier (no-op single-process)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
 
 
 def _save_orbax(
@@ -137,6 +152,11 @@ def _save_orbax(
         if directory.exists():
             shutil.rmtree(directory)
         os.replace(tmp, directory)
+    if jax.process_count() > 1:
+        # All processes wait for the swap: no one may act on the returned
+        # path (or start a next save reusing tmp) while the rename is in
+        # flight on process 0.
+        _sync("pdtpu:ckpt:orbax")
     return str(directory)
 
 

@@ -115,10 +115,14 @@ class DistributedTrainer(Trainer):
             for k, v in batch.items()
         }
 
-    # -- checkpointing: process-0 gating (reference :214-221) -------------
-    def save_checkpoint(self, state: TrainState) -> str | None:
-        if not is_process_zero():
-            return None
+    # -- checkpointing ------------------------------------------------------
+    def save_checkpoint(self, state: TrainState) -> str:
+        # NOT process-0-gated: every process must call — sharded (orbax)
+        # saves are collective (each process writes its own shards; gating
+        # would deadlock process 0 inside the commit barrier), and the npz
+        # path does its own process-0 write gating internally. This is where
+        # the reference's rank-0 torch.save (distributed_trainer.py:214-221)
+        # is structurally wrong for sharded state, per SURVEY.md §5.4.
         return super().save_checkpoint(state)
 
     def train(self, dataloader, *, state=None, profiler=None, num_steps=None):
