@@ -47,6 +47,7 @@ from pytorch_distributed_tpu.config import ModelConfig
 from pytorch_distributed_tpu.ops.attention import multi_head_attention
 from pytorch_distributed_tpu.ops.layers import activation, dense, dropout, layer_norm
 from pytorch_distributed_tpu.ops.remat import apply_remat, checkpoint_name
+from pytorch_distributed_tpu.ops.tp import tp_copy
 
 Params = dict[str, Any]
 
@@ -123,12 +124,20 @@ def _block(
     layer_key: jax.Array | None,
     deterministic: bool,
     seq_axis: str | None = None,
+    tensor_axis: str | None = None,
 ) -> jax.Array:
     """Pre-norm residual block (reference my_gpt2.py:121-134):
-    x + attn(ln_1(x)); x + mlp(ln_2(x))."""
+    x + attn(ln_1(x)); x + mlp(ln_2(x)).
+
+    ``tensor_axis`` (explicit/shard_map TP): the block computes on its LOCAL
+    heads / hidden columns. Megatron f (tp_copy) sits between each norm and
+    the column-parallel matmul; the row-parallel projections psum
+    (tp_reduce, inside dense) before adding their replicated bias. Dropout
+    keys are identical across tensor shards, so the replicated activations
+    stay bitwise-replicated.
+    """
     eps = cfg.layer_norm_epsilon
-    b, t, e = x.shape
-    h, d = cfg.n_head, cfg.head_dim
+    b, t = x.shape[:2]
 
     if layer_key is not None:
         k_attn, k_resid1, k_mlp = jax.random.split(layer_key, 3)
@@ -137,6 +146,7 @@ def _block(
 
     # --- attention sub-block (reference my_gpt2.py:38-77, merged QKV :21) ---
     a = layer_norm(x, bp["ln_1"], eps=eps)
+    a = tp_copy(a, tensor_axis)
     # One matmul for q/k/v with explicit qkv/head kernel axes: under tensor
     # parallelism the head axis is sharded and slicing the (replicated)
     # qkv axis needs no resharding.
@@ -150,21 +160,28 @@ def _block(
         dropout_key=k_attn,
         deterministic=deterministic,
         seq_axis=seq_axis,
-    ).reshape(b, t, e)
+    ).reshape(b, t, -1)  # [B, T, E] (E/tp local columns under explicit TP)
     if not _flash_kernel_active(cfg, t, seq_axis, deterministic):
         # On the Pallas path the kernel's o output is already saved by the
         # remat policy (ops/remat._flash_call_policy); tagging here too would
         # store the same tensor twice (~12 MB/layer at bench shapes).
         a = checkpoint_name(a, "attn_out")
-    a = checkpoint_name(dense(a, bp["attn"]["c_proj"]), "attn_proj")
+    a = checkpoint_name(
+        dense(a, bp["attn"]["c_proj"], tp_reduce_axis=tensor_axis),
+        "attn_proj",
+    )
     a = dropout(a, cfg.resid_pdrop, k_resid1, deterministic=deterministic)
     x = x + a
 
     # --- MLP sub-block (reference my_gpt2.py:80-99) ---
     m = layer_norm(x, bp["ln_2"], eps=eps)
+    m = tp_copy(m, tensor_axis)
     m = checkpoint_name(dense(m, bp["mlp"]["c_fc"]), "mlp_fc")
     m = activation(cfg.activation_function)(m)
-    m = checkpoint_name(dense(m, bp["mlp"]["c_proj"]), "mlp_proj")
+    m = checkpoint_name(
+        dense(m, bp["mlp"]["c_proj"], tp_reduce_axis=tensor_axis),
+        "mlp_proj",
+    )
     m = dropout(m, cfg.resid_pdrop, k_mlp, deterministic=deterministic)
     return x + m
 
@@ -178,6 +195,7 @@ def apply(
     dropout_key: jax.Array | None = None,
     block_transform=None,
     seq_axis: str | None = None,
+    tensor_axis: str | None = None,
 ) -> jax.Array:
     """Forward pass: [B, T] token ids -> [B, T, V] float32 logits.
 
@@ -192,6 +210,12 @@ def apply(
     ``seq_axis``: set when called inside shard_map with the sequence dim
     sharded over that mesh axis (context parallelism): positions are offset
     by this shard's global start and attention runs the ring kernel.
+
+    ``tensor_axis``: set when called inside shard_map with block params
+    sharded Megatron-style over that mesh axis (explicit tensor
+    parallelism): blocks compute on local heads/columns with tp_copy /
+    tp_reduce at the region boundaries; embeddings, norms, and the tied
+    head are replicated.
     """
     if not deterministic and dropout_key is None:
         raise ValueError("training-mode apply() requires dropout_key")
@@ -230,7 +254,10 @@ def apply(
             else jax.random.fold_in(dropout_key, layer_idx)
         )
         return (
-            _block(carry, bp, cfg, layer_key, deterministic, seq_axis),
+            _block(
+                carry, bp, cfg, layer_key, deterministic, seq_axis,
+                tensor_axis,
+            ),
             None,
         )
 

@@ -32,6 +32,7 @@ from pytorch_distributed_tpu.ops.attention import multi_head_attention
 from pytorch_distributed_tpu.ops.layers import rms_norm
 from pytorch_distributed_tpu.ops.remat import apply_remat, checkpoint_name
 from pytorch_distributed_tpu.ops.rope import apply_rope, rope_angles
+from pytorch_distributed_tpu.ops.tp import tp_copy, tp_reduce
 
 Params = dict[str, Any]
 
@@ -68,34 +69,44 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     }
 
 
-def _block(x, bp, cfg: ModelConfig, cos, sin, seq_axis=None):
+def _block(x, bp, cfg: ModelConfig, cos, sin, seq_axis=None, tensor_axis=None):
     eps = cfg.layer_norm_epsilon
-    b, t, e = x.shape
-    h, kv, d = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    b, t = x.shape[:2]
+    d = cfg.head_dim
 
     a = rms_norm(x, bp["ln_attn"], eps=eps)
+    a = tp_copy(a, tensor_axis)
     q = checkpoint_name(a @ bp["attn"]["wq"].astype(a.dtype), "q")
     k = checkpoint_name(a @ bp["attn"]["wk"].astype(a.dtype), "k")
     v = checkpoint_name(a @ bp["attn"]["wv"].astype(a.dtype), "v")
-    q = apply_rope(q.reshape(b, t, h, d), cos, sin)
-    k = apply_rope(k.reshape(b, t, kv, d), cos, sin)
-    v = v.reshape(b, t, kv, d)
+    # Head counts derive from the (possibly tensor-sharded) kernel widths,
+    # so the same code runs full and per-TP-shard.
+    q = apply_rope(q.reshape(b, t, -1, d), cos, sin)
+    k = apply_rope(k.reshape(b, t, -1, d), cos, sin)
+    v = v.reshape(b, t, -1, d)
     a = multi_head_attention(
         q, k, v, impl=cfg.attention_impl, causal=True, deterministic=True,
         seq_axis=seq_axis,
-    ).reshape(b, t, h * d)
+    ).reshape(b, t, -1)
     if not _flash_kernel_active(cfg, t, seq_axis):
         # Pallas path: the kernel's o is already policy-saved (see gpt2.py).
         a = checkpoint_name(a, "attn_out")
-    x = x + checkpoint_name(a @ bp["attn"]["wo"].astype(a.dtype), "attn_proj")
+    x = x + checkpoint_name(
+        tp_reduce(a @ bp["attn"]["wo"].astype(a.dtype), tensor_axis),
+        "attn_proj",
+    )
 
     m = rms_norm(x, bp["ln_mlp"], eps=eps)
+    m = tp_copy(m, tensor_axis)
     gate = jax.nn.silu(
         checkpoint_name(m @ bp["mlp"]["gate"].astype(m.dtype), "mlp_gate")
     )
     up = checkpoint_name(m @ bp["mlp"]["up"].astype(m.dtype), "mlp_up")
     x = x + checkpoint_name(
-        (gate * up) @ bp["mlp"]["down"].astype(m.dtype), "mlp_proj"
+        tp_reduce(
+            (gate * up) @ bp["mlp"]["down"].astype(m.dtype), tensor_axis
+        ),
+        "mlp_proj",
     )
     return x
 
@@ -109,12 +120,14 @@ def apply(
     dropout_key: jax.Array | None = None,
     block_transform=None,
     seq_axis: str | None = None,
+    tensor_axis: str | None = None,
 ) -> jax.Array:
     """[B, T] int tokens -> [B, T, V] float32 logits. The llama family is
     dropout-free (cfg presets zero the pdrop fields), so train and eval
     forward passes coincide. ``block_transform`` — see models/gpt2.py.
     ``seq_axis`` — sequence-sharded (context-parallel) call: RoPE angles are
-    offset by the shard's global start and attention runs the ring kernel."""
+    offset by the shard's global start and attention runs the ring kernel.
+    ``tensor_axis`` — explicit Megatron TP, see models/gpt2.py."""
     del dropout_key, deterministic
     b, t = input_ids.shape
     # Global length under sequence sharding (shards × local t): RoPE would
@@ -135,7 +148,7 @@ def apply(
     def scan_body(carry, bp):
         if block_transform is not None:
             bp = block_transform(bp)
-        return _block(carry, bp, cfg, cos, sin, seq_axis), None
+        return _block(carry, bp, cfg, cos, sin, seq_axis, tensor_axis), None
 
     body = apply_remat(scan_body, cfg.remat)
     x, _ = jax.lax.scan(body, x, params["blocks"])

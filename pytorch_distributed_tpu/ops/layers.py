@@ -17,15 +17,27 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from pytorch_distributed_tpu.ops.tp import tp_reduce
 
-def dense(x: jax.Array, params: dict, *, precision=None) -> jax.Array:
-    """y = x @ kernel + bias. kernel: [in, out]; bias optional."""
+
+def dense(
+    x: jax.Array, params: dict, *, precision=None, tp_reduce_axis=None
+) -> jax.Array:
+    """y = x @ kernel + bias. kernel: [in, out]; bias optional.
+
+    ``tp_reduce_axis``: name of a shard_map tensor axis this matmul is
+    row-parallel over — the kernel's input dim is sharded, each shard
+    computes a partial sum, and the psum (ops/tp.tp_reduce) runs BEFORE the
+    (replicated) bias is added so the bias is counted once.
+    """
     kernel = params["kernel"].astype(x.dtype)
     y = jax.lax.dot_general(
         x, kernel,
         (((x.ndim - 1,), (0,)), ((), ())),
         precision=precision,
     )
+    if tp_reduce_axis is not None:
+        y = tp_reduce(y, tp_reduce_axis)
     bias = params.get("bias")
     if bias is not None:
         y = y + bias.astype(y.dtype)

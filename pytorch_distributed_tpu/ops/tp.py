@@ -1,0 +1,76 @@
+"""Megatron-style tensor-parallel conjugate operators (the f / g pair).
+
+For hand-written (shard_map) tensor parallelism the model needs exactly two
+communication-bearing ops (Megatron-LM §3: the ``f`` and ``g`` conjugates):
+
+- ``tp_copy`` (f): identity in forward — the activation entering a
+  column-parallel region is used by EVERY tensor shard — and ``psum`` over
+  the tensor axis in backward, because each shard's cotangent covers only
+  its own heads/columns. Placed between a norm and the column-parallel
+  matmul so the norm's (replicated) param grads come out exact on every
+  shard with no post-hoc reduction.
+- ``tp_reduce`` (g): ``psum`` in forward — row-parallel matmuls produce
+  partial sums over the sharded contraction dim — and identity in backward
+  (the reduced activation's cotangent is already full on every shard).
+
+Biases of row-parallel projections must be added AFTER ``tp_reduce`` (they
+are replicated; adding before the psum would count them tensor-ways).
+
+Both ops are no-ops when ``axis`` is None, so model code can thread an
+optional ``tensor_axis`` straight through. Under shard_map's varying-axes
+typing, ``tp_reduce`` output is invariant over the tensor axis (psum), which
+is exactly the "activations replicated between parallel regions" contract.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_copy(x, axis):
+    # Value-identity, but TYPED varying over the tensor axis: downstream
+    # per-shard compute then carries varying cotangents and the ONLY psum is
+    # the hand-written one in the backward rule below. (If the output stayed
+    # typed invariant, vma-aware AD would insert its own psum when
+    # transposing the first sharded-matmul use — double-counting with ours.)
+    return jax.lax.pcast(x, (axis,), to="varying")
+
+
+def _tp_copy_fwd(x, axis):
+    return _tp_copy(x, axis), None
+
+
+def _tp_copy_bwd(axis, _res, g):
+    return (jax.lax.psum(g, axis),)
+
+
+_tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tp_reduce(x, axis):
+    return jax.lax.psum(x, axis)
+
+
+def _tp_reduce_fwd(x, axis):
+    return jax.lax.psum(x, axis), None
+
+
+def _tp_reduce_bwd(axis, _res, g):
+    return (g,)
+
+
+_tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+def tp_copy(x: jax.Array, axis: str | None) -> jax.Array:
+    """Identity fwd / psum-over-axis bwd (Megatron f). No-op if axis None."""
+    return x if axis is None else _tp_copy(x, axis)
+
+
+def tp_reduce(x: jax.Array, axis: str | None) -> jax.Array:
+    """psum-over-axis fwd / identity bwd (Megatron g). No-op if axis None."""
+    return x if axis is None else _tp_reduce(x, axis)

@@ -28,9 +28,16 @@ wrappers issue imperatively:
   FSDP shard_grad_op (ZeRO-2):
     - params replicated in compute (no forward gather);
     - grads ``psum_scatter``-ed along "fsdp" (+ pmean over "data");
-    - sharded Adam update, then ``all_gather`` of updated param shards —
-      reduce_scatter + sharded-update + all_gather ≡ one all-reduce's
-      bandwidth, with 1/N optimizer memory (reference train_fsdp.py:52-53).
+    - sharded Adam update, then the updated shards are re-materialised with
+      a psum of disjoint padded slices — numerically an all_gather, but
+      typed invariant under check_vma (reference train_fsdp.py:52-53).
+
+  Tensor parallelism ("tensor" axis, Megatron-style):
+    - block params sharded head-/column-aligned (parallel/sharding.py);
+      the model runs on local heads with the tp_copy/tp_reduce conjugate
+      pair (ops/tp.py) at the parallel-region boundaries — one psum after
+      each row-parallel projection in forward, one per region in backward;
+    - composes with every strategy above and with ring attention ("seq").
 
 Numerical contract: identical results to the single-device step and the pjit
 path (tested in tests/test_parallel.py) — psum ordering and mean-vs-sum
@@ -66,18 +73,25 @@ def _dp_axes(mesh_cfg: MeshConfig) -> tuple[str, ...]:
     return tuple(ax for ax in ("data", "fsdp") if getattr(mesh_cfg, ax) > 1)
 
 
-def _sharded_dim(spec: P) -> int | None:
-    for i, ax in enumerate(spec):
-        if ax is not None:
+def _axis_dim(spec: P, axis: str = "fsdp") -> int | None:
+    """Dim index the named mesh axis shards in this spec (specs may carry
+    several axes — e.g. fsdp AND tensor — so the dim must be looked up by
+    name, not 'first sharded')."""
+    for i, entry in enumerate(spec):
+        if entry == axis or (isinstance(entry, tuple) and axis in entry):
             return i
     return None
 
 
+def _spec_has(spec: P, axis: str) -> bool:
+    return _axis_dim(spec, axis) is not None
+
+
 def _gather_params(params, specs):
-    """all_gather each sharded leaf along its sharded dim (tiled)."""
+    """all_gather each fsdp-sharded leaf along its fsdp dim (tiled)."""
 
     def gather(leaf, spec):
-        dim = _sharded_dim(spec)
+        dim = _axis_dim(spec, "fsdp")
         if dim is None:
             return leaf
         return jax.lax.all_gather(leaf, "fsdp", axis=dim, tiled=True)
@@ -86,11 +100,11 @@ def _gather_params(params, specs):
 
 
 def _scatter_grads(grads, specs, fsdp_size: int):
-    """psum_scatter each leaf along its sharded dim; replicated leaves get a
-    plain psum. Produces the *sum* over the fsdp axis."""
+    """psum_scatter each leaf along its fsdp dim; leaves with no fsdp dim
+    get a plain psum. Produces the *sum* over the fsdp axis."""
 
     def scatter(leaf, spec):
-        dim = _sharded_dim(spec)
+        dim = _axis_dim(spec, "fsdp")
         if dim is None:
             return jax.lax.psum(leaf, "fsdp")
         return jax.lax.psum_scatter(
@@ -111,10 +125,7 @@ def make_explicit_train_step(
     """Build a jitted explicit-collective (state, batch, key) -> (state,
     metrics) step. State must already be placed per
     parallel.sharding.shard_train_state (same shardings as the pjit path)."""
-    if mesh_cfg.tensor > 1:
-        raise NotImplementedError(
-            "explicit path covers data/fsdp/seq axes; tensor uses the pjit path"
-        )
+    tensor_axis = "tensor" if mesh_cfg.tensor > 1 else None
     seq_axis = "seq" if mesh_cfg.seq > 1 else None
     if seq_axis is not None and model_cfg.attn_pdrop > 0:
         # Fail at build time, not mid-trace on the first step (ring attention
@@ -122,6 +133,16 @@ def make_explicit_train_step(
         raise NotImplementedError(
             "attention dropout is not supported with sequence parallelism "
             f"(attn_pdrop={model_cfg.attn_pdrop}); set attn_pdrop=0.0"
+        )
+    if tensor_axis is not None and model_cfg.attn_pdrop > 0:
+        # Per-shard draws from the replicated key would give head groups on
+        # different shards identical (correlated) masks that also differ
+        # from the single-device draw — silently breaking the parity
+        # contract. No modern config trains with attention dropout anyway.
+        raise NotImplementedError(
+            "attention dropout is not supported with explicit tensor "
+            f"parallelism (attn_pdrop={model_cfg.attn_pdrop}); set "
+            "attn_pdrop=0.0"
         )
     strategy = mesh_cfg.strategy
     fsdp_size = mesh_cfg.fsdp
@@ -186,6 +207,7 @@ def make_explicit_train_step(
             dropout_key=key,
             block_transform=gather_block,
             seq_axis=seq_axis,
+            tensor_axis=tensor_axis,
         )
         return cross_entropy_loss(logits, targets)
 
@@ -203,6 +225,18 @@ def make_explicit_train_step(
         have = getattr(getattr(x, "aval", None), "vma", frozenset())
         need = tuple(ax for ax in vary_axes if ax not in have)
         return jax.lax.pcast(x, need, to="varying") if need else x
+
+    def _vary_like(z, ref):
+        """pcast z to vary on ref's axes plus the batch axes — the vma its
+        gradient will have (tensor-sharded params produce tensor-varying
+        grads; replicated params produce tensor-invariant grads via the
+        tp_copy backward psum)."""
+        target = set(
+            getattr(getattr(ref, "aval", None), "vma", frozenset())
+        ) | set(vary_axes)
+        have = getattr(getattr(z, "aval", None), "vma", frozenset())
+        need = tuple(ax for ax in target if ax not in have)
+        return jax.lax.pcast(z, need, to="varying") if need else z
 
     def step_impl(state: TrainState, batch: dict, dropout_key: jax.Array):
         accum = batch["inputs"].shape[0]
@@ -228,7 +262,8 @@ def make_explicit_train_step(
             ), None
 
         zeros = jax.tree.map(
-            lambda p: _vary(jnp.zeros(p.shape, jnp.float32)), state.params
+            lambda p: _vary_like(jnp.zeros(p.shape, jnp.float32), p),
+            state.params,
         )
         (grads, loss_sum), _ = jax.lax.scan(
             scan_body,
@@ -240,10 +275,20 @@ def make_explicit_train_step(
 
         # --- the boundary: collectives fire here -------------------------
         if strategy == "full_shard" and fsdp_size > 1:
-            # grads are already sharded (AD transposed the all_gather into a
-            # psum_scatter that SUMMED over fsdp); normalise that sum into a
-            # mean, then average over the pure-data axis.
-            grads = jax.tree.map(lambda g: g / fsdp_size, grads)
+            # Sharded leaves: AD transposed the all_gather into a
+            # psum_scatter that SUMMED the per-shard grads over fsdp —
+            # normalise into a mean. Leaves with no fsdp-divisible dim were
+            # never gathered, so their grads are still per-shard partials:
+            # a real pmean over fsdp.
+            grads = jax.tree.map(
+                lambda g, spec: (
+                    g / fsdp_size
+                    if _spec_has(spec, "fsdp")
+                    else jax.lax.pmean(g, "fsdp")
+                ),
+                grads,
+                p_specs,
+            )
             if "data" in dp_axes and mesh_cfg.data > 1:
                 grads = jax.lax.pmean(grads, "data")
         elif strategy == "shard_grad_op" and fsdp_size > 1:
@@ -290,16 +335,34 @@ def make_explicit_train_step(
             )
             new_params = optax.apply_updates(state.params, updates)
 
-        # grad_norm over the distributed grad tree (sharded leaves need a
-        # cross-shard sum of squares).
-        if strategy in ("full_shard", "shard_grad_op") and fsdp_size > 1:
-            sq = sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in jax.tree.leaves(grads)
+        # grad_norm over the distributed grad tree: each leaf's squared sum
+        # is psum'd over exactly the axes that leaf is sharded over (fsdp
+        # and/or tensor); leaves replicated on an axis must NOT be summed
+        # over it.
+        norm_specs = (
+            shard_specs
+            if strategy in ("full_shard", "shard_grad_op") and fsdp_size > 1
+            else p_specs
+        )
+        spec_leaves = jax.tree.leaves(
+            norm_specs, is_leaf=lambda x: isinstance(x, P)
+        )
+        buckets: dict = {}
+        for g, spec in zip(jax.tree.leaves(grads), spec_leaves):
+            axes = tuple(
+                ax
+                for ax in ("fsdp", "tensor")
+                if getattr(mesh_cfg, ax) > 1 and _spec_has(spec, ax)
             )
-            grad_norm = jnp.sqrt(jax.lax.psum(sq, "fsdp"))
-        else:
-            grad_norm = optax.global_norm(grads)
+            buckets[axes] = buckets.get(axes, 0.0) + jnp.sum(
+                jnp.square(g.astype(jnp.float32))
+            )
+        sq = jnp.zeros((), jnp.float32)
+        for axes, val in buckets.items():
+            for ax in axes:
+                val = jax.lax.psum(val, ax)
+            sq = sq + val
+        grad_norm = jnp.sqrt(sq)
 
         metrics = {"loss": loss, "grad_norm": grad_norm}
         return TrainState(new_params, new_opt_state, state.step + 1), metrics
@@ -327,7 +390,7 @@ def make_explicit_train_step(
 
 def _shard_slice(full, spec: P, fsdp_size: int):
     """Take this device's fsdp slice of a replicated array (ZeRO-2 update)."""
-    dim = _sharded_dim(spec)
+    dim = _axis_dim(spec, "fsdp")
     if dim is None:
         return full
     idx = jax.lax.axis_index("fsdp")
@@ -343,7 +406,7 @@ def _unscatter(shard, full_like, spec: P):
     all_gather output stays typed varying, which would fail the replicated
     out_specs under check_vma. (Bandwidth 2x an all_gather; the teaching
     path trades that for a machine-checked replication invariant.)"""
-    dim = _sharded_dim(spec)
+    dim = _axis_dim(spec, "fsdp")
     if dim is None:
         return shard
     idx = jax.lax.axis_index("fsdp")
