@@ -211,13 +211,15 @@ class MeshConfig:
 
     Axes follow the scaling-book convention: data (DP replicas), fsdp
     (parameter/grad/opt-state sharding), tensor (TP), seq (sequence/context
-    parallelism for ring attention). Sizes of 1 collapse the axis.
+    parallelism for ring attention), pipe (pipeline stages — GPipe-style
+    layer partitioning, parallel/pipeline.py). Sizes of 1 collapse the axis.
     """
 
     data: int = 1
     fsdp: int = 1
     tensor: int = 1
     seq: int = 1
+    pipe: int = 1
 
     # FSDP sharding strategy, mirroring reference train_fsdp.py:49-59:
     #   "full_shard"     — params+grads+opt sharded (ZeRO-3)
@@ -225,7 +227,7 @@ class MeshConfig:
     #   "no_shard"       — DDP-equivalent
     strategy: str = "full_shard"
 
-    axis_order: tuple[str, ...] = ("data", "fsdp", "seq", "tensor")
+    axis_order: tuple[str, ...] = ("pipe", "data", "fsdp", "seq", "tensor")
 
     def __post_init__(self) -> None:
         if self.strategy not in ("full_shard", "shard_grad_op", "no_shard"):
@@ -233,7 +235,7 @@ class MeshConfig:
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.seq
+        return self.data * self.fsdp * self.tensor * self.seq * self.pipe
 
     @property
     def shape(self) -> dict[str, int]:

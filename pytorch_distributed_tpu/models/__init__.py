@@ -12,15 +12,24 @@ from pytorch_distributed_tpu.config import ModelConfig
 class ModelApi(NamedTuple):
     init: Callable[[jax.Array, ModelConfig], dict]
     apply: Callable[..., jax.Array]
+    # Phase functions — the same forward split at pipeline-stage boundaries
+    # (embed | blocks | head), used by parallel/pipeline.py.
+    embed: Callable[..., jax.Array]
+    run_blocks: Callable[..., jax.Array]
+    head: Callable[..., jax.Array]
 
 
 def get_model(cfg: ModelConfig) -> ModelApi:
     if cfg.family == "gpt2":
         from pytorch_distributed_tpu.models import gpt2
 
-        return ModelApi(gpt2.init, gpt2.apply)
+        return ModelApi(
+            gpt2.init, gpt2.apply, gpt2.embed, gpt2.run_blocks, gpt2.head
+        )
     if cfg.family == "llama":
         from pytorch_distributed_tpu.models import llama
 
-        return ModelApi(llama.init, llama.apply)
+        return ModelApi(
+            llama.init, llama.apply, llama.embed, llama.run_blocks, llama.head
+        )
     raise KeyError(f"unknown model family {cfg.family!r}")

@@ -264,7 +264,36 @@ def apply(
     body = apply_remat(scan_body, cfg.remat)
     layer_ids = jnp.arange(cfg.n_layer)
     x, _ = jax.lax.scan(body, x, (params["blocks"], layer_ids))
+    return head(params, x, cfg)
 
+
+# -- phase functions (pipeline parallelism, parallel/pipeline.py) ----------
+# The same forward pass split at the stage boundaries GPipe partitions at:
+# embed | n_layer blocks | head; apply() ends by calling head() so the two
+# paths cannot drift. Deterministic mode only (the pipeline path rejects
+# dropout configs at build time).
+
+
+def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
+    b, t = input_ids.shape
+    if t > cfg.n_ctx:
+        raise ValueError(f"sequence length {t} exceeds n_ctx {cfg.n_ctx}")
+    x = params["wte"][input_ids] + params["wpe"][:t]
+    return x.astype(jnp.dtype(cfg.dtype))
+
+
+def run_blocks(blocks: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """Scan a stack of [L_local, ...] block params over x (L_local may be a
+    pipeline stage's slice of the full depth)."""
+
+    def body(carry, bp):
+        return _block(carry, bp, cfg, None, True), None
+
+    x, _ = jax.lax.scan(apply_remat(body, cfg.remat), x, blocks)
+    return x
+
+
+def head(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     x = layer_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
     # Tied LM head (reference my_gpt2.py:200-206): logits = x @ wte^T. The MXU
     # accumulates in f32; cfg.logits_dtype controls what lands in HBM.

@@ -152,7 +152,31 @@ def apply(
 
     body = apply_remat(scan_body, cfg.remat)
     x, _ = jax.lax.scan(body, x, params["blocks"])
+    return head(params, x, cfg)
 
+
+# -- phase functions (pipeline parallelism) — see models/gpt2.py -----------
+
+
+def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
+    t = input_ids.shape[1]
+    if t > cfg.n_ctx:
+        raise ValueError(f"sequence length {t} exceeds n_ctx {cfg.n_ctx}")
+    return params["wte"][input_ids].astype(jnp.dtype(cfg.dtype))
+
+
+def run_blocks(blocks: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    t = x.shape[1]
+    cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta)
+
+    def body(carry, bp):
+        return _block(carry, bp, cfg, cos, sin), None
+
+    x, _ = jax.lax.scan(apply_remat(body, cfg.remat), x, blocks)
+    return x
+
+
+def head(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     x = rms_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
     return jnp.einsum(
         "bte,ev->btv", x, params["lm_head"].astype(x.dtype),

@@ -123,12 +123,9 @@ def ring_attention(
     ) | {axis_name}
 
     def varying(x):
-        need = tuple(
-            ax
-            for ax in target_vma
-            if ax not in getattr(jax.typeof(x), "vma", frozenset())
-        )
-        return jax.lax.pcast(x, need, to="varying") if need else x
+        from pytorch_distributed_tpu.ops.tp import pvary_missing
+
+        return pvary_missing(x, tuple(target_vma))
 
     acc0 = (
         varying(jnp.zeros((b, h, tl, d), jnp.float32)),

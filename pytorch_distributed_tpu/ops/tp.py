@@ -71,6 +71,16 @@ def tp_copy(x: jax.Array, axis: str | None) -> jax.Array:
     return x if axis is None else _tp_copy(x, axis)
 
 
+def pvary_missing(x: jax.Array, axes) -> jax.Array:
+    """pcast ``x`` to varying on whichever of ``axes`` it is not already
+    varying on (pcast rejects axes that are already varying). The shared
+    helper for initialising shard_map scan/cond accumulators under
+    check_vma typing."""
+    have = getattr(getattr(x, "aval", None), "vma", frozenset())
+    need = tuple(ax for ax in axes if ax not in have)
+    return jax.lax.pcast(x, need, to="varying") if need else x
+
+
 def tp_reduce(x: jax.Array, axis: str | None) -> jax.Array:
     """psum-over-axis fwd / identity bwd (Megatron g). No-op if axis None."""
     return x if axis is None else _tp_reduce(x, axis)

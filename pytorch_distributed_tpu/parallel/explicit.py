@@ -63,6 +63,7 @@ from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 from pytorch_distributed_tpu.models import ModelApi
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.remat import apply_remat
+from pytorch_distributed_tpu.ops.tp import pvary_missing
 from pytorch_distributed_tpu.parallel.mesh import batch_partition_spec
 from pytorch_distributed_tpu.parallel.sharding import param_partition_specs
 from pytorch_distributed_tpu.train.state import TrainState
@@ -222,9 +223,7 @@ def make_explicit_train_step(
     )
 
     def _vary(x):
-        have = getattr(getattr(x, "aval", None), "vma", frozenset())
-        need = tuple(ax for ax in vary_axes if ax not in have)
-        return jax.lax.pcast(x, need, to="varying") if need else x
+        return pvary_missing(x, vary_axes)
 
     def _vary_like(z, ref):
         """pcast z to vary on ref's axes plus the batch axes — the vma its
@@ -234,9 +233,7 @@ def make_explicit_train_step(
         target = set(
             getattr(getattr(ref, "aval", None), "vma", frozenset())
         ) | set(vary_axes)
-        have = getattr(getattr(z, "aval", None), "vma", frozenset())
-        need = tuple(ax for ax in target if ax not in have)
-        return jax.lax.pcast(z, need, to="varying") if need else z
+        return pvary_missing(z, tuple(target))
 
     def step_impl(state: TrainState, batch: dict, dropout_key: jax.Array):
         accum = batch["inputs"].shape[0]

@@ -1,0 +1,246 @@
+"""Pipeline parallelism (GPipe-style) over a "pipe" mesh axis.
+
+Beyond-reference capability (the reference has no PP at all, SURVEY.md §2.2):
+the transformer's depth is partitioned across pipeline stages — stage s owns
+layers [s·L/S, (s+1)·L/S) as its slice of the stacked [L, ...] block params —
+and the gradient-accumulation microbatches stream through the stages:
+
+  tick t: stage s processes microbatch (t - s); activations hop to the next
+  stage over ``lax.ppermute`` (ICI neighbour exchange). M microbatches over
+  S stages take M + S - 1 ticks; the (S-1)-tick bubble is GPipe's.
+
+The whole schedule is ONE ``lax.scan`` inside ``shard_map``, so reverse-mode
+AD mechanically yields the backward pipeline: the transpose of the scan runs
+ticks in reverse and the transpose of each ppermute is the reverse hop —
+no hand-written backward schedule. Stage 0 embeds, the last stage runs the
+LM head + loss (gated with ``lax.cond`` so other stages skip the
+vocab-sized matmul); bubble ticks compute on garbage whose loss contribution
+— and therefore gradient — is exactly zero.
+
+Composes with the data axis (DDP): batch rows shard over "data", grads
+pmean over it. Deterministic mode only (dropout configs are rejected at
+build time, like the ring/TP paths). fsdp/tensor/seq composition inside a
+stage is future work — rejected explicitly.
+
+Typed under check_vma: block params vary over "pipe" (sharded), replicated
+leaves (embeddings, final norm, head) are pvaried for local differentiation
+and their per-stage partial grads are psum'd over "pipe" at the boundary —
+stage contributions are disjoint (embed grad lives on stage 0, head grad on
+the last stage), so the psum reconstructs the exact full gradient.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from pytorch_distributed_tpu.models import ModelApi
+from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.ops.tp import pvary_missing
+from pytorch_distributed_tpu.train.state import TrainState
+
+
+def pipeline_state_specs(state: TrainState, mesh_cfg: MeshConfig):
+    """Block leaves shard their stacked layer dim over "pipe"; everything
+    else replicates. Optimizer moments mirror the params tree."""
+
+    def spec_for(path, leaf):
+        keys = [getattr(p, "key", None) for p in path]
+        if "blocks" in keys and getattr(leaf, "ndim", 0) >= 1:
+            return P("pipe", *([None] * (leaf.ndim - 1)))
+        return P()
+
+    p_specs = jax.tree_util.tree_map_with_path(spec_for, state.params)
+    o_specs = jax.tree_util.tree_map_with_path(spec_for, state.opt_state)
+    return TrainState(params=p_specs, opt_state=o_specs, step=P())
+
+
+def shard_pipeline_state(state: TrainState, mesh: Mesh, mesh_cfg: MeshConfig):
+    specs = pipeline_state_specs(state, mesh_cfg)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(state, shardings), shardings
+
+
+def make_pipeline_train_step(
+    model: ModelApi,
+    model_cfg: ModelConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    mesh_cfg: MeshConfig,
+    state: TrainState,
+    train_cfg: TrainConfig | None = None,
+) -> Callable:
+    """Build the jitted pipelined (state, batch, key) -> (state, metrics)
+    step. ``batch`` is [M, B_global, T]; M (the grad-accumulation factor)
+    doubles as the pipeline microbatch count. State must be placed by
+    ``shard_pipeline_state``.
+
+    Pass ``train_cfg`` so unsupported optimizer couplings are rejected at
+    build time: gradient clipping's global norm would mix pipe-sharded and
+    replicated leaves inside shard_map (a check_vma error at trace time
+    otherwise)."""
+    if mesh_cfg.pipe <= 1:
+        raise ValueError("pipeline path needs mesh_cfg.pipe > 1")
+    if train_cfg is not None and train_cfg.grad_clip_norm:
+        raise NotImplementedError(
+            "grad_clip_norm is not supported on the pipeline path: the clip "
+            "scale must be computed from a pipe-aware global norm"
+        )
+    if mesh_cfg.fsdp > 1 or mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
+        raise NotImplementedError(
+            "pipeline composes with the data axis only (in-stage "
+            "fsdp/tensor/seq sharding is future work)"
+        )
+    if (
+        model_cfg.embd_pdrop > 0
+        or model_cfg.attn_pdrop > 0
+        or model_cfg.resid_pdrop > 0
+    ):
+        raise NotImplementedError(
+            "pipeline path is deterministic-only; zero the pdrop fields"
+        )
+    n_stages = mesh_cfg.pipe
+    if model_cfg.n_layer % n_stages != 0:
+        raise ValueError(
+            f"n_layer={model_cfg.n_layer} not divisible by "
+            f"pipe={n_stages} stages"
+        )
+    data_axis = "data" if mesh_cfg.data > 1 else None
+    # No wrap-around pair: stage 0 always takes the embed branch, so shipping
+    # the last stage's activation back to it would be a wasted hop; ppermute
+    # delivers zeros to stages with no source, which stage 0 ignores.
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    specs = pipeline_state_specs(state, mesh_cfg)
+    batch_spec = P(None, "data" if mesh_cfg.data > 1 else None, None)
+
+    vary_axes = ("pipe",) + (("data",) if data_axis else ())
+
+    def _vary(x):
+        return pvary_missing(x, vary_axes)
+
+    def forward_loss(params, inputs_mb, targets_mb):
+        """Pipelined forward over all M microbatches; mean loss."""
+        m = inputs_mb.shape[0]
+        b, t = inputs_mb.shape[1], inputs_mb.shape[2]
+        stage = jax.lax.axis_index("pipe")
+        n_ticks = m + n_stages - 1
+
+        def tick(carry, tk):
+            x_buf, loss_acc = carry
+            in_idx = jnp.clip(tk, 0, m - 1)
+            x_in = jax.lax.cond(
+                stage == 0,
+                lambda: model.embed(
+                    params,
+                    jax.lax.dynamic_index_in_dim(
+                        inputs_mb, in_idx, 0, keepdims=False
+                    ),
+                    model_cfg,
+                ),
+                lambda: x_buf,
+            )
+            y = model.run_blocks(params["blocks"], x_in, model_cfg)
+            out_idx = tk - (n_stages - 1)
+            valid_out = (stage == n_stages - 1) & (out_idx >= 0)
+            loss_t = jax.lax.cond(
+                valid_out,
+                lambda: cross_entropy_loss(
+                    model.head(params, y, model_cfg),
+                    jax.lax.dynamic_index_in_dim(
+                        targets_mb, jnp.clip(out_idx, 0, m - 1), 0,
+                        keepdims=False,
+                    ),
+                ),
+                lambda: _vary(jnp.zeros((), jnp.float32)),
+            )
+            x_next = jax.lax.ppermute(y, "pipe", perm)
+            return (x_next, loss_acc + loss_t), None
+
+        x0 = _vary(
+            jnp.zeros((b, t, model_cfg.n_embd), jnp.dtype(model_cfg.dtype))
+        )
+        (x_buf, loss_sum), _ = jax.lax.scan(
+            tick,
+            (x0, _vary(jnp.zeros((), jnp.float32))),
+            jnp.arange(n_ticks),
+        )
+        # Only the last stage accumulated loss; psum replicates the mean.
+        return jax.lax.psum(loss_sum, "pipe") / m
+
+    grad_fn = jax.value_and_grad(forward_loss)
+
+    def step_impl(state: TrainState, batch: dict, dropout_key: jax.Array):
+        del dropout_key  # deterministic-only path
+        vparams = jax.tree.map(_vary, state.params)
+        loss, grads = grad_fn(vparams, batch["inputs"], batch["targets"])
+
+        # Replicated leaves hold disjoint per-stage partials — psum over
+        # pipe reconstructs the full grad; pipe-sharded block leaves are
+        # already exact.
+        grads = jax.tree.map(
+            lambda g, spec: (
+                g if _has_pipe(spec) else jax.lax.psum(g, "pipe")
+            ),
+            grads,
+            specs.params,
+        )
+        if data_axis:
+            grads = jax.lax.pmean(grads, data_axis)
+            loss = jax.lax.pmean(loss, data_axis)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+
+        sq_sharded = jnp.zeros((), jnp.float32)
+        sq_repl = jnp.zeros((), jnp.float32)
+        for g, spec in zip(
+            jax.tree.leaves(grads),
+            jax.tree.leaves(
+                specs.params, is_leaf=lambda x: isinstance(x, P)
+            ),
+        ):
+            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if _has_pipe(spec):
+                sq_sharded = sq_sharded + s
+            else:
+                sq_repl = sq_repl + s
+        grad_norm = jnp.sqrt(
+            jax.lax.psum(sq_sharded, "pipe") + sq_repl
+        )
+        metrics = {"loss": loss, "grad_norm": grad_norm}
+        return TrainState(new_params, new_opt_state, state.step + 1), metrics
+
+    smapped = shard_map(
+        step_impl,
+        mesh=mesh,
+        in_specs=(
+            specs,
+            {"inputs": batch_spec, "targets": batch_spec},
+            P(),
+        ),
+        out_specs=(specs, {"loss": P(), "grad_norm": P()}),
+        check_vma=True,
+    )
+    return jax.jit(smapped, donate_argnums=(0,))
+
+
+def _has_pipe(spec: P) -> bool:
+    return any(
+        entry == "pipe" or (isinstance(entry, tuple) and "pipe" in entry)
+        for entry in spec
+    )

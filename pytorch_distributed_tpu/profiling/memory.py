@@ -110,10 +110,23 @@ def measured_memory(device=None) -> dict:
     }
 
 
-def save_memory_snapshot(path: str | Path) -> str:
+def snapshot_supported(device=None) -> bool:
+    """Whether the backend can produce a device-memory profile. Relay/proxy
+    PJRT backends that expose no memory stats also lack the executable
+    heap-profile C API — calling it there aborts the PROCESS (absl fatal in
+    PJRT_Executable_SizeOfGeneratedCodeInBytes), so callers must gate on
+    this instead of try/except."""
+    device = device or jax.local_devices()[0]
+    return bool(device.memory_stats() or device.platform == "cpu")
+
+
+def save_memory_snapshot(path: str | Path) -> str | None:
     """Dump the current device-memory profile (pprof .prof — open with
     ``pprof`` or pprof-web; the memory_viz-pickle analogue of
-    reference :112-117)."""
+    reference :112-117). Returns None (no file) when the backend cannot
+    produce one — see snapshot_supported."""
+    if not snapshot_supported():
+        return None
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     jax.profiler.save_device_memory_profile(str(path))

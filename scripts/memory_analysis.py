@@ -98,10 +98,16 @@ def main() -> int:
         ratio = meas["peak_bytes_in_use"] / est["total_bytes_estimate"]
         print(f"measured/estimated: {ratio:.2f}x")
     else:
-        print("(backend exposes no memory stats — CPU run)")
+        print(
+            "(backend exposes no memory stats — CPU run or relay TPU; "
+            "the analytic estimate above is the HBM budget)"
+        )
 
     snap = save_memory_snapshot(args.snapshot)
-    print(f"\nmemory snapshot written to {snap} (pprof format)")
+    if snap is None:
+        print("\n(memory snapshot unsupported on this backend — skipped)")
+    else:
+        print(f"\nmemory snapshot written to {snap} (pprof format)")
     return 0
 
 

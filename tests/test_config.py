@@ -45,6 +45,8 @@ def test_grad_accum_math():
 def test_mesh_config():
     m = MeshConfig(data=2, fsdp=4)
     assert m.num_devices == 8
-    assert m.shape == {"data": 2, "fsdp": 4, "seq": 1, "tensor": 1}
+    assert m.shape == {
+        "pipe": 1, "data": 2, "fsdp": 4, "seq": 1, "tensor": 1,
+    }
     with pytest.raises(ValueError):
         MeshConfig(strategy="zeRO9000")
