@@ -70,6 +70,14 @@ class ModelConfig:
     # concern (parallel/), not a per-config switch.
     attention_impl: str = "naive"
 
+    # Mixture-of-Experts (ops/moe.py): 0 = dense MLP (reference behavior);
+    # >0 replaces each block's MLP with n_experts expert MLPs and a top-1
+    # router (gpt2 family). Aux-loss coefficient weights the Switch
+    # load-balancing term added to the training objective.
+    n_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    moe_aux_coef: float = 0.01
+
     def __post_init__(self) -> None:
         if self.n_embd % self.n_head != 0:
             raise ValueError(
@@ -83,6 +91,10 @@ class ModelConfig:
             raise ValueError(
                 f"unknown attention_impl: {self.attention_impl!r} "
                 "(implemented: naive, flash)"
+            )
+        if self.n_experts and self.family != "gpt2":
+            raise ValueError(
+                "MoE (n_experts > 0) is implemented for the gpt2 family"
             )
 
     @property
@@ -220,6 +232,10 @@ class MeshConfig:
     tensor: int = 1
     seq: int = 1
     pipe: int = 1
+    # Expert parallelism (MoE): expert weights shard over this axis and the
+    # batch shards over it too (it is a data axis for non-expert params);
+    # all_to_all moves token slots to their expert's owner (ops/moe.py).
+    expert: int = 1
 
     # FSDP sharding strategy, mirroring reference train_fsdp.py:49-59:
     #   "full_shard"     — params+grads+opt sharded (ZeRO-3)
@@ -227,7 +243,9 @@ class MeshConfig:
     #   "no_shard"       — DDP-equivalent
     strategy: str = "full_shard"
 
-    axis_order: tuple[str, ...] = ("pipe", "data", "fsdp", "seq", "tensor")
+    axis_order: tuple[str, ...] = (
+        "pipe", "data", "fsdp", "expert", "seq", "tensor"
+    )
 
     def __post_init__(self) -> None:
         if self.strategy not in ("full_shard", "shard_grad_op", "no_shard"):
@@ -235,7 +253,10 @@ class MeshConfig:
 
     @property
     def num_devices(self) -> int:
-        return self.data * self.fsdp * self.tensor * self.seq * self.pipe
+        return (
+            self.data * self.fsdp * self.tensor * self.seq * self.pipe
+            * self.expert
+        )
 
     @property
     def shape(self) -> dict[str, int]:

@@ -102,16 +102,30 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
                 },
             },
             "ln_2": ln((l, e)),
-            "mlp": {
-                "c_fc": {
-                    "kernel": normal(keys[4], (l, e, f), 0.02),
-                    "bias": jnp.zeros((l, f), pdt),
-                },
-                "c_proj": {
-                    "kernel": normal(keys[5], (l, f, e), 0.02),
-                    "bias": jnp.zeros((l, e), pdt),
-                },
-            },
+            "mlp": (
+                {
+                    "c_fc": {
+                        "kernel": normal(keys[4], (l, e, f), 0.02),
+                        "bias": jnp.zeros((l, f), pdt),
+                    },
+                    "c_proj": {
+                        "kernel": normal(keys[5], (l, f, e), 0.02),
+                        "bias": jnp.zeros((l, e), pdt),
+                    },
+                }
+                if not cfg.n_experts
+                else {
+                    # MoE (ops/moe.py): per-layer router + stacked expert
+                    # weights (biasless experts, Switch-style).
+                    "router": normal(keys[6], (l, e, cfg.n_experts), 0.02),
+                    "w_in": normal(
+                        keys[4], (l, cfg.n_experts, e, f), 0.02
+                    ),
+                    "w_out": normal(
+                        keys[5], (l, cfg.n_experts, f, e), 0.02
+                    ),
+                }
+            ),
         },
         "ln_f": ln((e,)),
     }
@@ -125,9 +139,11 @@ def _block(
     deterministic: bool,
     seq_axis: str | None = None,
     tensor_axis: str | None = None,
-) -> jax.Array:
+    expert_axis: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
     """Pre-norm residual block (reference my_gpt2.py:121-134):
-    x + attn(ln_1(x)); x + mlp(ln_2(x)).
+    x + attn(ln_1(x)); x + mlp(ln_2(x)). Returns (x, moe_aux_loss) — the
+    aux term is zero for the dense MLP.
 
     ``tensor_axis`` (explicit/shard_map TP): the block computes on its LOCAL
     heads / hidden columns. Megatron f (tp_copy) sits between each norm and
@@ -173,17 +189,29 @@ def _block(
     a = dropout(a, cfg.resid_pdrop, k_resid1, deterministic=deterministic)
     x = x + a
 
-    # --- MLP sub-block (reference my_gpt2.py:80-99) ---
+    # --- MLP sub-block (reference my_gpt2.py:80-99; MoE when n_experts) ---
     m = layer_norm(x, bp["ln_2"], eps=eps)
-    m = tp_copy(m, tensor_axis)
-    m = checkpoint_name(dense(m, bp["mlp"]["c_fc"]), "mlp_fc")
-    m = activation(cfg.activation_function)(m)
-    m = checkpoint_name(
-        dense(m, bp["mlp"]["c_proj"], tp_reduce_axis=tensor_axis),
-        "mlp_proj",
-    )
+    if cfg.n_experts:
+        from pytorch_distributed_tpu.ops.moe import moe_mlp
+
+        m, aux = moe_mlp(
+            m,
+            bp["mlp"],
+            activation=activation(cfg.activation_function),
+            capacity_factor=cfg.expert_capacity_factor,
+            expert_axis=expert_axis,
+        )
+    else:
+        aux = jnp.zeros((), jnp.float32)
+        m = tp_copy(m, tensor_axis)
+        m = checkpoint_name(dense(m, bp["mlp"]["c_fc"]), "mlp_fc")
+        m = activation(cfg.activation_function)(m)
+        m = checkpoint_name(
+            dense(m, bp["mlp"]["c_proj"], tp_reduce_axis=tensor_axis),
+            "mlp_proj",
+        )
     m = dropout(m, cfg.resid_pdrop, k_mlp, deterministic=deterministic)
-    return x + m
+    return x + m, aux
 
 
 def apply(
@@ -196,8 +224,12 @@ def apply(
     block_transform=None,
     seq_axis: str | None = None,
     tensor_axis: str | None = None,
+    expert_axis: str | None = None,
+    return_aux: bool = False,
 ) -> jax.Array:
     """Forward pass: [B, T] token ids -> [B, T, V] float32 logits.
+    With ``return_aux=True`` returns (logits, moe_aux_loss) — the summed
+    Switch load-balancing term over layers (zero for dense configs).
 
     Mirrors reference my_gpt2.py:163-188 (trunk) + :211-213 (tied head):
     wte + wpe -> embd dropout -> n_layer pre-norm blocks -> ln_f -> tied head.
@@ -245,6 +277,7 @@ def apply(
     # Scan over stacked block params; remat each block body. The per-layer
     # dropout key is folded from (dropout_key, layer_index) inside the scan.
     def scan_body(carry, xs):
+        h, aux_sum = carry
         bp, layer_idx = xs
         if block_transform is not None:
             bp = block_transform(bp)
@@ -253,18 +286,30 @@ def apply(
             if deterministic
             else jax.random.fold_in(dropout_key, layer_idx)
         )
-        return (
-            _block(
-                carry, bp, cfg, layer_key, deterministic, seq_axis,
-                tensor_axis,
-            ),
-            None,
+        h, aux = _block(
+            h, bp, cfg, layer_key, deterministic, seq_axis, tensor_axis,
+            expert_axis,
         )
+        return (h, aux_sum + aux), None
 
     body = apply_remat(scan_body, cfg.remat)
     layer_ids = jnp.arange(cfg.n_layer)
-    x, _ = jax.lax.scan(body, x, (params["blocks"], layer_ids))
-    return head(params, x, cfg)
+    # The aux carry must vary on every axis the activations vary on (any
+    # sharded batch/param axis under shard_map), not just the expert axis —
+    # scan requires carry input/output vma to match.
+    from pytorch_distributed_tpu.ops.tp import pvary_missing
+
+    aux0 = pvary_missing(
+        jnp.zeros((), jnp.float32),
+        tuple(getattr(jax.typeof(x), "vma", frozenset())),
+    )
+    (x, aux_total), _ = jax.lax.scan(
+        body, (x, aux0), (params["blocks"], layer_ids)
+    )
+    logits = head(params, x, cfg)
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 # -- phase functions (pipeline parallelism, parallel/pipeline.py) ----------
@@ -284,10 +329,12 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 def run_blocks(blocks: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     """Scan a stack of [L_local, ...] block params over x (L_local may be a
-    pipeline stage's slice of the full depth)."""
+    pipeline stage's slice of the full depth). Dense configs only — the
+    pipeline path rejects MoE at build time (aux loss is discarded here)."""
 
     def body(carry, bp):
-        return _block(carry, bp, cfg, None, True), None
+        h, _aux = _block(carry, bp, cfg, None, True)
+        return h, None
 
     x, _ = jax.lax.scan(apply_remat(body, cfg.remat), x, blocks)
     return x

@@ -121,14 +121,18 @@ def apply(
     block_transform=None,
     seq_axis: str | None = None,
     tensor_axis: str | None = None,
+    expert_axis: str | None = None,
+    return_aux: bool = False,
 ) -> jax.Array:
     """[B, T] int tokens -> [B, T, V] float32 logits. The llama family is
     dropout-free (cfg presets zero the pdrop fields), so train and eval
     forward passes coincide. ``block_transform`` — see models/gpt2.py.
     ``seq_axis`` — sequence-sharded (context-parallel) call: RoPE angles are
     offset by the shard's global start and attention runs the ring kernel.
-    ``tensor_axis`` — explicit Megatron TP, see models/gpt2.py."""
-    del dropout_key, deterministic
+    ``tensor_axis`` — explicit Megatron TP, see models/gpt2.py.
+    ``expert_axis``/``return_aux`` — MoE is gpt2-only (config validation
+    rejects llama n_experts>0); accepted for API uniformity."""
+    del dropout_key, deterministic, expert_axis
     b, t = input_ids.shape
     # Global length under sequence sharding (shards × local t): RoPE would
     # silently extrapolate past the trained context window otherwise.
@@ -152,7 +156,10 @@ def apply(
 
     body = apply_remat(scan_body, cfg.remat)
     x, _ = jax.lax.scan(body, x, params["blocks"])
-    return head(params, x, cfg)
+    logits = head(params, x, cfg)
+    if return_aux:
+        return logits, jnp.zeros((), jnp.float32)
+    return logits
 
 
 # -- phase functions (pipeline parallelism) — see models/gpt2.py -----------

@@ -1,0 +1,120 @@
+"""Mixture-of-Experts MLP with expert parallelism (Switch-style top-1).
+
+Beyond-reference capability (the reference MLP is dense, my_gpt2.py:80-99):
+the block's MLP is replaced by n_experts expert MLPs and a learned top-1
+router, in the Mesh-TensorFlow/Switch formulation:
+
+  router logits [T, X] -> top-1 expert per token; position-in-expert by
+  cumsum; tokens beyond the per-expert capacity C are dropped (their MLP
+  output is zero — the residual stream carries them unchanged).
+  dispatch one-hot [T, X, C] scatters token vectors to [X, C, D] expert
+  batches; experts run as ONE batched matmul pair (MXU-friendly — no
+  ragged shapes, no host control flow); combine weights (the router
+  probability at the kept position) gather outputs back to [T, D].
+
+Expert parallelism (``expert_axis`` inside shard_map): expert weights are
+sharded over the axis, tokens are sharded over it too (it acts as a data
+axis for non-expert parameters), and two ``all_to_all`` collectives move
+token slots to their expert's owner and back:
+
+  [X, C_local, D] --all_to_all--> [X/n, n*C_local, D]   (dispatch)
+  expert compute on local experts
+  [X/n, n*C_local, D] --all_to_all--> [X, C_local, D]   (return)
+
+Capacity semantics under EP are per-shard (each shard may send up to
+C_local tokens to each expert), so a generous capacity_factor reproduces
+the single-device result exactly — pinned by tests/test_moe.py.
+
+Deterministic routing (no jitter noise). The Switch load-balancing
+auxiliary loss is returned alongside the output and both trainer paths add
+``moe_aux_coef * aux`` to the objective; under EP it is computed per
+token-shard and averaged (the standard distributed convention — differs
+from the global-batch product only at O(1e-4) on balanced batches).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def expert_capacity(
+    tokens: int, n_experts: int, capacity_factor: float
+) -> int:
+    """Per-expert token slots: ceil(tokens/experts * factor), min 1."""
+    return max(1, int(tokens * capacity_factor / n_experts + 0.999999))
+
+
+def moe_mlp(
+    x: jax.Array,  # [B, T, D]
+    params: dict,  # router [D, X]; w_in [X, D, F]; w_out [X, F, D]
+    *,
+    activation,
+    capacity_factor: float = 1.25,
+    expert_axis: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (output [B, T, D], aux_loss scalar).
+
+    aux_loss is the Switch load-balancing term: X * sum_e(fraction_e *
+    mean_prob_e), minimised (=1) by uniform routing.
+    """
+    b, t, d = x.shape
+    n_tokens = b * t
+    xt = x.reshape(n_tokens, d)
+    n_experts = params["router"].shape[-1]
+
+    # --- routing (f32 for a stable softmax) ------------------------------
+    logits = (
+        xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    )  # [T, X]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+
+    one_hot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+    # Position of each token within its expert's queue (0-based).
+    pos_in_expert = (jnp.cumsum(one_hot, axis=0) - one_hot) * one_hot
+    pos = jnp.sum(pos_in_expert, axis=-1).astype(jnp.int32)  # [T]
+    cap = expert_capacity(n_tokens, n_experts, capacity_factor)
+    keep = pos < cap
+
+    # Switch aux loss: fraction of tokens per expert x mean router prob.
+    fraction = jnp.mean(one_hot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = n_experts * jnp.sum(fraction * mean_prob)
+
+    # --- dispatch: [T, X, C] one-hot scatter -----------------------------
+    dispatch = (
+        one_hot * keep[:, None]
+    )[:, :, None] * jax.nn.one_hot(pos, cap, dtype=jnp.float32)[:, None, :]
+    expert_in = jnp.einsum(
+        "txc,td->xcd", dispatch, xt.astype(jnp.float32)
+    ).astype(x.dtype)  # [X, C, D]
+
+    if expert_axis is not None:
+        # Send each expert's slots to its owning shard; slots from all
+        # shards concatenate along the capacity dim.
+        expert_in = jax.lax.all_to_all(
+            expert_in, expert_axis, split_axis=0, concat_axis=1, tiled=True
+        )  # [X/n, n*C, D]
+
+    # --- expert compute: one batched matmul pair -------------------------
+    h = jnp.einsum(
+        "xcd,xdf->xcf", expert_in, params["w_in"].astype(expert_in.dtype)
+    )
+    h = activation(h)
+    expert_out = jnp.einsum(
+        "xcf,xfd->xcd", h, params["w_out"].astype(h.dtype)
+    )
+
+    if expert_axis is not None:
+        expert_out = jax.lax.all_to_all(
+            expert_out, expert_axis, split_axis=1, concat_axis=0, tiled=True
+        )  # back to [X, C, D]
+
+    # --- combine: gather each token's slot, scale by its gate ------------
+    combine = dispatch * gate[:, None, None]
+    out = jnp.einsum(
+        "txc,xcd->td", combine, expert_out.astype(jnp.float32)
+    )
+    return out.astype(x.dtype).reshape(b, t, d), aux_loss

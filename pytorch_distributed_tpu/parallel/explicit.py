@@ -128,6 +128,26 @@ def make_explicit_train_step(
     parallel.sharding.shard_train_state (same shardings as the pjit path)."""
     tensor_axis = "tensor" if mesh_cfg.tensor > 1 else None
     seq_axis = "seq" if mesh_cfg.seq > 1 else None
+    expert_axis = "expert" if mesh_cfg.expert > 1 else None
+    if expert_axis is not None:
+        if not model_cfg.n_experts:
+            raise ValueError(
+                "expert axis > 1 needs an MoE model (n_experts > 0)"
+            )
+        if model_cfg.n_experts % mesh_cfg.expert:
+            raise ValueError(
+                f"n_experts={model_cfg.n_experts} not divisible by "
+                f"expert={mesh_cfg.expert}"
+            )
+        if (
+            mesh_cfg.strategy != "no_shard"
+            or mesh_cfg.tensor > 1
+            or mesh_cfg.seq > 1
+        ):
+            raise NotImplementedError(
+                "expert parallelism composes with the data axis "
+                "(strategy=no_shard) only for now"
+            )
     if seq_axis is not None and model_cfg.attn_pdrop > 0:
         # Fail at build time, not mid-trace on the first step (ring attention
         # has no attention-dropout support, ops/attention.py).
@@ -200,7 +220,7 @@ def make_explicit_train_step(
             }
         else:
             params = params_shard
-        logits = model.apply(
+        out = model.apply(
             params,
             inputs,
             model_cfg,
@@ -209,8 +229,14 @@ def make_explicit_train_step(
             block_transform=gather_block,
             seq_axis=seq_axis,
             tensor_axis=tensor_axis,
+            expert_axis=expert_axis,
+            return_aux=bool(model_cfg.n_experts),
         )
-        return cross_entropy_loss(logits, targets)
+        logits, aux = out if model_cfg.n_experts else (out, 0.0)
+        loss = cross_entropy_loss(logits, targets)
+        if model_cfg.n_experts:
+            loss = loss + model_cfg.moe_aux_coef * aux
+        return loss
 
     grad_fn = jax.value_and_grad(forward_loss)
 
@@ -219,7 +245,9 @@ def make_explicit_train_step(
     # typed as unvarying under check_vma; they must be pcast to match the
     # varying gradients/losses the scan body produces.
     vary_axes = tuple(
-        ax for ax in ("data", "fsdp", "seq") if getattr(mesh_cfg, ax) > 1
+        ax
+        for ax in ("data", "fsdp", "seq", "expert")
+        if getattr(mesh_cfg, ax) > 1
     )
 
     def _vary(x):
@@ -295,7 +323,22 @@ def make_explicit_train_step(
             if "data" in dp_axes and mesh_cfg.data > 1:
                 grads = jax.lax.pmean(grads, "data")
         else:
-            # DDP: one all-reduce(AVG) over every batch axis.
+            # DDP: one all-reduce(AVG) over every batch axis. Expert
+            # parallelism first: expert-sharded leaves already hold the SUM
+            # over all expert-shards' tokens (the backward all_to_all routed
+            # every token's contribution to its expert's owner) — normalise
+            # by the shard count; everything else is a per-shard partial
+            # needing a real pmean over the expert axis.
+            if expert_axis is not None:
+                grads = jax.tree.map(
+                    lambda g, spec: (
+                        g / mesh_cfg.expert
+                        if _spec_has(spec, "expert")
+                        else jax.lax.pmean(g, expert_axis)
+                    ),
+                    grads,
+                    p_specs,
+                )
             for ax in dp_axes:
                 grads = jax.lax.pmean(grads, ax)
 
@@ -309,6 +352,8 @@ def make_explicit_train_step(
         # loss all-reduce(AVG) (reference distributed_trainer.py:131-154).
         for ax in dp_axes:
             loss = jax.lax.pmean(loss, ax)
+        if expert_axis is not None:
+            loss = jax.lax.pmean(loss, expert_axis)
 
         # --- update -------------------------------------------------------
         if strategy == "shard_grad_op" and fsdp_size > 1:
@@ -348,7 +393,7 @@ def make_explicit_train_step(
         for g, spec in zip(jax.tree.leaves(grads), spec_leaves):
             axes = tuple(
                 ax
-                for ax in ("fsdp", "tensor")
+                for ax in ("fsdp", "tensor", "expert")
                 if getattr(mesh_cfg, ax) > 1 and _spec_has(spec, ax)
             )
             buckets[axes] = buckets.get(axes, 0.0) + jnp.sum(

@@ -71,10 +71,12 @@ def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
 def batch_partition_spec(cfg: MeshConfig) -> P:
     """Global-batch sharding: batch dim split over data AND fsdp axes (FSDP
     is data parallelism with sharded state — each fsdp shard still consumes
-    its own slice of the batch); sequence dim split over seq axis for
-    context parallelism. [A, B, T] batches shard B and T."""
+    its own slice of the batch) AND the expert axis (expert parallelism
+    shards tokens too; all_to_all moves them to their expert's owner);
+    sequence dim split over seq for context parallelism. [A, B, T] batches
+    shard B and T."""
     batch_axes = tuple(
-        ax for ax in ("data", "fsdp") if getattr(cfg, ax) > 1
+        ax for ax in ("data", "fsdp", "expert") if getattr(cfg, ax) > 1
     ) or None
     seq_axis = "seq" if cfg.seq > 1 else None
     return P(None, batch_axes, seq_axis)
@@ -101,4 +103,4 @@ def make_batch_put(mesh: Mesh, cfg: MeshConfig):
 def data_parallel_size(cfg: MeshConfig) -> int:
     """How many ways the batch is split (the 'world size' in the reference's
     grad-accum rule, distributed_trainer.py:84-88)."""
-    return cfg.data * cfg.fsdp
+    return cfg.data * cfg.fsdp * cfg.expert

@@ -113,6 +113,11 @@ def make_pipeline_train_step(
         raise NotImplementedError(
             "pipeline path is deterministic-only; zero the pdrop fields"
         )
+    if model_cfg.n_experts:
+        raise NotImplementedError(
+            "MoE models are not supported on the pipeline path (the aux "
+            "loss would need stage-aware plumbing)"
+        )
     n_stages = mesh_cfg.pipe
     if model_cfg.n_layer % n_stages != 0:
         raise ValueError(

@@ -60,6 +60,13 @@ _TENSOR_RULES: dict[tuple[str, ...], int] = {
 }
 _TENSOR_SUFFIX_LENS = (3, 2)
 
+# Expert-parallel placement: stacked MoE leaves [L, X, ...] shard their
+# expert dim over the "expert" axis; the router stays replicated.
+_EXPERT_RULES: dict[tuple[str, ...], int] = {
+    ("mlp", "w_in"): 1,
+    ("mlp", "w_out"): 1,
+}
+
 
 def _path_keys(path) -> tuple[str, ...]:
     """String keys of a jax tree path (non-string entries like list indices
@@ -105,6 +112,18 @@ def _leaf_spec(
                 f"divisible by tensor={mesh_cfg.tensor}"
             )
         spec[tdim] = "tensor"
+
+    if mesh_cfg.expert > 1:
+        keys = _path_keys(path)
+        edim = _EXPERT_RULES.get(keys[-2:])
+        if edim is not None:
+            if shape[edim] % mesh_cfg.expert != 0:
+                raise ValueError(
+                    f"expert dim {edim} of param "
+                    f"{'/'.join(keys)} (shape {shape}) is not divisible "
+                    f"by expert={mesh_cfg.expert}"
+                )
+            spec[edim] = "expert"
 
     if shard_fsdp and mesh_cfg.fsdp > 1:
         best_dim, best_size = None, 0

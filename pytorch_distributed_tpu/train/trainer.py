@@ -78,16 +78,22 @@ def make_train_step(
         )
 
     def micro_loss(params, inputs, targets, key):
-        logits = model.apply(
+        out = model.apply(
             params,
             inputs,
             model_cfg,
             deterministic=not train_mode,
             dropout_key=key,
+            return_aux=bool(model_cfg.n_experts),
         )
+        logits, aux = out if model_cfg.n_experts else (out, 0.0)
         if logits_sharding is not None:
             logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
-        return cross_entropy_loss(logits, targets)
+        loss = cross_entropy_loss(logits, targets)
+        if model_cfg.n_experts:
+            # Switch load-balancing term (ops/moe.py).
+            loss = loss + model_cfg.moe_aux_coef * aux
+        return loss
 
     grad_fn = jax.value_and_grad(micro_loss)
 

@@ -46,7 +46,7 @@ def test_mesh_config():
     m = MeshConfig(data=2, fsdp=4)
     assert m.num_devices == 8
     assert m.shape == {
-        "pipe": 1, "data": 2, "fsdp": 4, "seq": 1, "tensor": 1,
+        "pipe": 1, "data": 2, "fsdp": 4, "expert": 1, "seq": 1, "tensor": 1,
     }
     with pytest.raises(ValueError):
         MeshConfig(strategy="zeRO9000")
