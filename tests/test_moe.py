@@ -89,13 +89,9 @@ def test_moe_gpt2_trains():
     assert losses[-1] < losses[0] - 0.5, losses[::10]
 
 
-@pytest.mark.parametrize("expert,data", [(4, 1), (2, 2), (4, 2)])
-def test_expert_parallel_matches_single_device(eight_devices, expert, data):
-    # aux coef 0 for EXACT parity: the load-balancing term is computed per
-    # token-shard and averaged under EP (the standard distributed-Switch
-    # convention), which differs from the global-batch product by O(1e-4) —
-    # test_expert_parallel_aux_close covers the aux-on case.
-    cfg = _moe_cfg(moe_aux_coef=0.0)
+def _ep_reference(moe_aux_coef=0.0):
+    """Shared setup for the EP parity tests: (cfg, model, tx, batch, ref)."""
+    cfg = _moe_cfg(moe_aux_coef=moe_aux_coef)
     model = get_model(cfg)
     tcfg = TrainConfig(
         global_batch_size=16, micro_batch_size=16, num_steps=1,
@@ -111,17 +107,10 @@ def test_expert_parallel_matches_single_device(eight_devices, expert, data):
     ref_state, ref_m = make_train_step(model, cfg, tx, donate=False)(
         state0, batch, jax.random.key(0)
     )
+    return cfg, model, tx, batch, ref_state, ref_m
 
-    mcfg = MeshConfig(expert=expert, data=data, strategy="no_shard")
-    mesh = make_mesh(mcfg)
-    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
-    state, _ = shard_train_state(state, mesh, mcfg)
-    step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
-    put = make_batch_put(mesh, mcfg)
-    new_state, m = step(state, put(batch), jax.random.key(0))
 
-    # Routing is deterministic and capacity is generous, so no tokens drop
-    # on either side and the math is identical up to reduction order.
+def _assert_matches_ref(new_state, m, ref_state, ref_m):
     assert float(m["loss"]) == pytest.approx(float(ref_m["loss"]), abs=2e-5)
     assert float(m["grad_norm"]) == pytest.approx(
         float(ref_m["grad_norm"]), abs=1e-4
@@ -133,24 +122,30 @@ def test_expert_parallel_matches_single_device(eight_devices, expert, data):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.parametrize("expert,data", [(4, 1), (2, 2), (4, 2)])
+def test_expert_parallel_matches_single_device(eight_devices, expert, data):
+    # aux coef 0 for EXACT parity: the load-balancing term is computed per
+    # token-shard and averaged under EP (the standard distributed-Switch
+    # convention), which differs from the global-batch product by O(1e-4) -
+    # test_expert_parallel_aux_close covers the aux-on case.
+    cfg, model, tx, batch, ref_state, ref_m = _ep_reference()
+    mcfg = MeshConfig(expert=expert, data=data, strategy="no_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
+    put = make_batch_put(mesh, mcfg)
+    new_state, m = step(state, put(batch), jax.random.key(0))
+    # Routing is deterministic and capacity is generous, so no tokens drop
+    # on either side and the math is identical up to reduction order.
+    _assert_matches_ref(new_state, m, ref_state, ref_m)
+
+
 def test_expert_parallel_aux_close(eight_devices):
     """With the aux loss ON, EP's per-shard aux averaging tracks the global
     value closely (same objective up to O(1e-4) on balanced batches)."""
-    cfg = _moe_cfg()  # default moe_aux_coef
-    model = get_model(cfg)
-    tcfg = TrainConfig(
-        global_batch_size=16, micro_batch_size=16, num_steps=1,
-        learning_rate=1e-3,
-    )
-    tx = make_optimizer(tcfg)
-    rng = np.random.default_rng(0)
-    batch = {
-        "inputs": rng.integers(0, 128, (1, 16, 16)).astype(np.int32),
-        "targets": rng.integers(0, 128, (1, 16, 16)).astype(np.int32),
-    }
-    state0 = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
-    _, ref_m = make_train_step(model, cfg, tx, donate=False)(
-        state0, batch, jax.random.key(0)
+    cfg, model, tx, batch, _ref_state, ref_m = _ep_reference(
+        moe_aux_coef=0.01
     )
     mcfg = MeshConfig(expert=4, strategy="no_shard")
     mesh = make_mesh(mcfg)
@@ -159,6 +154,22 @@ def test_expert_parallel_aux_close(eight_devices):
     step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
     _, m = step(state, make_batch_put(mesh, mcfg)(batch), jax.random.key(0))
     assert float(m["loss"]) == pytest.approx(float(ref_m["loss"]), abs=1e-3)
+
+
+def test_pjit_moe_expert_sharding_matches(eight_devices):
+    """The automatic (pjit) path also runs MoE with expert-sharded weights:
+    XLA's SPMD partitioner handles the dispatch einsums (and their
+    backward) from the NamedShardings alone."""
+    from pytorch_distributed_tpu.parallel import make_parallel_train_step
+
+    cfg, model, tx, batch, ref_state, ref_m = _ep_reference()
+    mcfg = MeshConfig(expert=4, data=2, strategy="no_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    step, put = make_parallel_train_step(model, cfg, tx, mesh, mcfg, state)
+    new_state, m = step(state, put(batch), jax.random.key(0))
+    _assert_matches_ref(new_state, m, ref_state, ref_m)
 
 
 def test_expert_axis_requires_moe_model(eight_devices):
