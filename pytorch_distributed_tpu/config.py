@@ -56,6 +56,15 @@ class ModelConfig:
     # (the MXU still accumulates in f32; cross-entropy upcasts to f32).
     logits_dtype: str = "float32"
 
+    # Fuse the LM-head matmul into the cross-entropy loss
+    # (ops/losses.linear_cross_entropy): logits are produced and consumed in
+    # vocab blocks, so the [B, T, V] logits tensor never exists — the
+    # largest activation in the step (823 MB bf16 at GPT-2 bench shapes,
+    # 2.1 GB at llama-3 vocabulary). Training-loop path only (trainer /
+    # pjit); apply() still returns logits, and the explicit/pipeline
+    # teaching paths keep the materialised head.
+    fused_head_ce: bool = False
+
     # Selective activation checkpointing per block (reference my_gpt2.py:145,
     # 175-183 + pytorch_utils.py:5-17): save compute-intensive matmul outputs,
     # recompute the rest. One of: "none", "full", "dots", "dots_no_batch",

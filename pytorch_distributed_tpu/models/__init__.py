@@ -17,6 +17,10 @@ class ModelApi(NamedTuple):
     embed: Callable[..., jax.Array]
     run_blocks: Callable[..., jax.Array]
     head: Callable[..., jax.Array]
+    # (params) -> (head weight array, ops.losses layout tag): the LM-head
+    # matrix the fused head+CE loss multiplies against — tied wte [V, E]
+    # ("ve") for gpt2, untied lm_head [E, V] ("ev") for llama.
+    head_weight: Callable[[dict], tuple[jax.Array, str]]
 
 
 def get_model(cfg: ModelConfig) -> ModelApi:
@@ -24,12 +28,15 @@ def get_model(cfg: ModelConfig) -> ModelApi:
         from pytorch_distributed_tpu.models import gpt2
 
         return ModelApi(
-            gpt2.init, gpt2.apply, gpt2.embed, gpt2.run_blocks, gpt2.head
+            gpt2.init, gpt2.apply, gpt2.embed, gpt2.run_blocks, gpt2.head,
+            lambda params: (params["wte"], "ve"),
         )
     if cfg.family == "llama":
         from pytorch_distributed_tpu.models import llama
 
         return ModelApi(
-            llama.init, llama.apply, llama.embed, llama.run_blocks, llama.head
+            llama.init, llama.apply, llama.embed, llama.run_blocks,
+            llama.head,
+            lambda params: (params["lm_head"], "ev"),
         )
     raise KeyError(f"unknown model family {cfg.family!r}")

@@ -1,0 +1,216 @@
+"""KV-cache autoregressive decoding for both model families.
+
+The reference repo has no inference path at all — training only. A complete
+framework needs one: this module adds prefill + single-token decode over a
+preallocated KV cache, and a jit-compiled ``generate`` loop (greedy or
+temperature sampling), for gpt2 and llama params produced by
+``models.get_model(cfg)``.
+
+Design (TPU-first):
+- The cache is a pytree of stacked per-layer tensors ``k/v [L, B, S, Hkv, D]``
+  preallocated at ``max_len`` — static shapes throughout; the current length
+  ``pos`` is a traced scalar. ``forward`` handles both prefill (T = prompt
+  length) and decode (T = 1) with one code path: new keys/values are
+  ``dynamic_update_slice``d into the cache at ``pos`` and attention masks
+  key positions ``> pos + i`` (padding beyond the write point is masked
+  out, so stale cache contents are never read).
+- Layers run under the same ``lax.scan``-over-stacked-params structure as
+  training; the per-layer cache slices ride the scan's xs/ys.
+- Attention here is the naive einsum path in f32: decode is matmul-light
+  ([B, H, T, S] with T = 1), so flash-kernel dispatch is pointless.
+- The generate loop is a ``lax.fori_loop`` over steps inside one jit; the
+  output buffer is preallocated [B, prompt + max_new] and updated in place.
+
+No dropout (inference), no remat (nothing to save).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.config import ModelConfig
+from pytorch_distributed_tpu.ops.layers import (
+    activation,
+    dense,
+    layer_norm,
+    rms_norm,
+)
+from pytorch_distributed_tpu.ops.rope import apply_rope, rope_angles
+
+Params = dict[str, Any]
+Cache = dict[str, jax.Array]
+
+
+def init_cache(
+    cfg: ModelConfig, batch: int, max_len: int, dtype=None
+) -> Cache:
+    """Preallocate a [L, B, max_len, Hkv, D] key/value cache pair."""
+    if max_len > cfg.n_ctx:
+        raise ValueError(f"max_len {max_len} exceeds n_ctx {cfg.n_ctx}")
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    shape = (cfg.n_layer, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_attention(q, ck, cv, pos):
+    """q [B, T, H, D] against the full cache [B, S, Hkv, D]; queries sit at
+    global positions pos..pos+T-1, keys j are valid iff j <= pos + i."""
+    b, t, h, d = q.shape
+    s, hkv = ck.shape[1], ck.shape[2]
+    if hkv != h:
+        rep = h // hkv
+        ck = jnp.repeat(ck, rep, axis=2)
+        cv = jnp.repeat(cv, rep, axis=2)
+    scores = jnp.einsum(
+        "bthd,bshd->bhts", q, ck, preferred_element_type=jnp.float32
+    ) / (d**0.5)
+    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1)
+    scores = jnp.where(kpos <= qpos, scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    return jnp.einsum("bhts,bshd->bthd", w, cv)
+
+
+def _write(cache_layer, new, pos):
+    """Insert new [B, T, Hkv, D] at time offset pos."""
+    return jax.lax.dynamic_update_slice(
+        cache_layer, new.astype(cache_layer.dtype), (0, pos, 0, 0)
+    )
+
+
+def _gpt2_block(x, bp, ck, cv, pos, cfg):
+    eps = cfg.layer_norm_epsilon
+    b, t = x.shape[:2]
+    a = layer_norm(x, bp["ln_1"], eps=eps)
+    qkv = dense(a, bp["attn"]["c_attn"])  # [B, T, 3, H, D]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ck, cv = _write(ck, k, pos), _write(cv, v, pos)
+    a = _cached_attention(q, ck, cv, pos).reshape(b, t, -1)
+    x = x + dense(a, bp["attn"]["c_proj"])
+    m = layer_norm(x, bp["ln_2"], eps=eps)
+    m = activation(cfg.activation_function)(dense(m, bp["mlp"]["c_fc"]))
+    return x + dense(m, bp["mlp"]["c_proj"]), ck, cv
+
+
+def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin):
+    eps = cfg.layer_norm_epsilon
+    b, t = x.shape[:2]
+    d = cfg.head_dim
+    a = rms_norm(x, bp["ln_attn"], eps=eps)
+    q = apply_rope((a @ bp["attn"]["wq"].astype(a.dtype)).reshape(b, t, -1, d), cos, sin)
+    k = apply_rope((a @ bp["attn"]["wk"].astype(a.dtype)).reshape(b, t, -1, d), cos, sin)
+    v = (a @ bp["attn"]["wv"].astype(a.dtype)).reshape(b, t, -1, d)
+    ck, cv = _write(ck, k, pos), _write(cv, v, pos)
+    a = _cached_attention(q, ck, cv, pos).reshape(b, t, -1)
+    x = x + a @ bp["attn"]["wo"].astype(a.dtype)
+    m = rms_norm(x, bp["ln_mlp"], eps=eps)
+    gate = jax.nn.silu(m @ bp["mlp"]["gate"].astype(m.dtype))
+    up = m @ bp["mlp"]["up"].astype(m.dtype)
+    return x + (gate * up) @ bp["mlp"]["down"].astype(m.dtype), ck, cv
+
+
+def forward(
+    params: Params,
+    input_ids: jax.Array,  # [B, T] — full prompt (prefill) or one token
+    cfg: ModelConfig,
+    cache: Cache,
+    pos: jax.Array | int,  # tokens already in the cache
+) -> tuple[jax.Array, Cache]:
+    """Run T tokens at positions pos..pos+T-1. Returns ([B, T, V] logits,
+    updated cache)."""
+    if cfg.n_experts:
+        raise NotImplementedError("decode does not support MoE configs yet")
+    b, t = input_ids.shape
+    dtype = jnp.dtype(cfg.dtype)
+    pos = jnp.asarray(pos, jnp.int32)
+
+    if cfg.family == "gpt2":
+        wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, t, axis=0)
+        x = (params["wte"][input_ids] + wpe).astype(dtype)
+        block = partial(_gpt2_block, cfg=cfg)
+    elif cfg.family == "llama":
+        x = params["wte"][input_ids].astype(dtype)
+        cos, sin = rope_angles(
+            t, cfg.head_dim, cfg.rope_theta, offset=pos
+        )
+        block = partial(_llama_block, cfg=cfg, cos=cos, sin=sin)
+    else:
+        raise KeyError(f"unknown model family {cfg.family!r}")
+
+    def scan_body(x, xs):
+        bp, ck_l, cv_l = xs
+        x, ck_l, cv_l = block(x, bp, ck_l, cv_l, pos)
+        return x, (ck_l, cv_l)
+
+    x, (ck, cv) = jax.lax.scan(
+        scan_body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+
+    from pytorch_distributed_tpu.models import get_model
+
+    logits = get_model(cfg).head(params, x, cfg)
+    return logits, {"k": ck, "v": cv}
+
+
+def _sample(logits, temperature, key):
+    """[B, V] -> [B] next tokens. temperature 0 = greedy."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        key, logits.astype(jnp.float32) / temperature, axis=-1
+    ).astype(jnp.int32)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "max_len"),
+)
+def generate(
+    params: Params,
+    prompt: jax.Array,  # [B, Tp] int
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    max_len: int | None = None,
+) -> jax.Array:
+    """Autoregressive generation: returns [B, Tp + max_new_tokens].
+
+    One compiled program: prefill over the prompt, then a fori_loop of
+    single-token decode steps against the cache.
+    """
+    b, tp = prompt.shape
+    total = tp + max_new_tokens
+    max_len = max_len or total
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature sampling requires a PRNG key")
+    if key is None:
+        key = jax.random.key(0)  # unused on the greedy path
+
+    cache = init_cache(cfg, b, max_len)
+    logits, cache = forward(params, prompt, cfg, cache, 0)
+    next_tok = _sample(logits[:, -1], temperature, key)
+
+    out = jnp.zeros((b, total), jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, prompt.astype(jnp.int32), (0, 0))
+    out = out.at[:, tp].set(next_tok)
+
+    def step(i, carry):
+        out, cache, tok = carry
+        pos = tp + i
+        logits, cache = forward(params, tok[:, None], cfg, cache, pos)
+        nxt = _sample(
+            logits[:, -1], temperature, jax.random.fold_in(key, i)
+        )
+        out = out.at[:, pos + 1].set(nxt)
+        return out, cache, nxt
+
+    out, _, _ = jax.lax.fori_loop(
+        0, max_new_tokens - 1, step, (out, cache, next_tok)
+    )
+    return out

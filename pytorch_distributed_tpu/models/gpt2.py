@@ -226,10 +226,15 @@ def apply(
     tensor_axis: str | None = None,
     expert_axis: str | None = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Forward pass: [B, T] token ids -> [B, T, V] float32 logits.
     With ``return_aux=True`` returns (logits, moe_aux_loss) — the summed
     Switch load-balancing term over layers (zero for dense configs).
+    With ``return_hidden=True`` the head matmul is skipped and the
+    final-norm hidden states [B, T, E] come back in place of logits — the
+    input the fused head+cross-entropy loss consumes (config
+    ``fused_head_ce``).
 
     Mirrors reference my_gpt2.py:163-188 (trunk) + :211-213 (tied head):
     wte + wpe -> embd dropout -> n_layer pre-norm blocks -> ln_f -> tied head.
@@ -306,10 +311,13 @@ def apply(
     (x, aux_total), _ = jax.lax.scan(
         body, (x, aux0), (params["blocks"], layer_ids)
     )
-    logits = head(params, x, cfg)
+    if return_hidden:
+        out = layer_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+    else:
+        out = head(params, x, cfg)
     if return_aux:
-        return logits, aux_total
-    return logits
+        return out, aux_total
+    return out
 
 
 # -- phase functions (pipeline parallelism, parallel/pipeline.py) ----------

@@ -123,6 +123,7 @@ def apply(
     tensor_axis: str | None = None,
     expert_axis: str | None = None,
     return_aux: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """[B, T] int tokens -> [B, T, V] float32 logits. The llama family is
     dropout-free (cfg presets zero the pdrop fields), so train and eval
@@ -156,10 +157,15 @@ def apply(
 
     body = apply_remat(scan_body, cfg.remat)
     x, _ = jax.lax.scan(body, x, params["blocks"])
-    logits = head(params, x, cfg)
+    if return_hidden:
+        # Final-norm hidden states for the fused head+CE loss (see
+        # models/gpt2.py apply docstring).
+        out = rms_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+    else:
+        out = head(params, x, cfg)
     if return_aux:
-        return logits, jnp.zeros((), jnp.float32)
-    return logits
+        return out, jnp.zeros((), jnp.float32)
+    return out
 
 
 # -- phase functions (pipeline parallelism) — see models/gpt2.py -----------

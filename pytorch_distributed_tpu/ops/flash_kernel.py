@@ -382,7 +382,6 @@ def _bwd_call(q, k, v, do, lse, delta, causal, scale, bq, bk, interpret):
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_mha(
     q: jax.Array,  # [B, Hq, T, D]
     k: jax.Array,  # [B, Hkv, T, D]
@@ -396,10 +395,23 @@ def flash_mha(
     """Flash attention returning (o, lse).
 
     lse [B, Hq, T] f32 is a primal output on purpose: the remat "names"
-    policy (ops/remat._flash_call_policy) saves every output of this call,
-    so with (o, lse) saved the backward runs only the fused gradient kernel
-    — no forward re-run.
+    policy (ops/remat._flash_call_policy) saves every output of the
+    underlying custom-VJP call, so with (o, lse) saved the backward runs
+    only the fused gradient kernel — no forward re-run. lse is returned
+    under ``stop_gradient``: it is a softmax *residual*, and this op does
+    not define gradients through it (an lse-based regularizer would need
+    its own VJP).
     """
+    o, lse = _flash_mha_vjp(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
+    return o, jax.lax.stop_gradient(lse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_mha_vjp(
+    q, k, v, causal, scale, block_q, block_k, interpret
+):
     if q.shape[2] != k.shape[2] or k.shape != v.shape:
         raise ValueError(
             f"flash_mha requires T == S self-attention with matching K/V: "
@@ -413,7 +425,9 @@ def flash_mha(
 
 
 def _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    o, lse = flash_mha(q, k, v, causal, scale, block_q, block_k, interpret)
+    o, lse = _flash_mha_vjp(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )
     return (o, lse), (q, k, v, o, lse)
 
 
@@ -438,4 +452,4 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, interpret, res, cts):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)
+_flash_mha_vjp.defvjp(_flash_mha_fwd, _flash_mha_bwd)

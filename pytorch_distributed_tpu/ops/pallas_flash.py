@@ -76,26 +76,6 @@ def flash_attention(
     )
 
 
-def _largest_divisor_block(n: int, candidates=(1024, 512, 256, 128)) -> int:
-    for c in candidates:
-        if n % c == 0:
-            return c
-    return n
-
-
-def _block_sizes(t: int, s: int):
-    from jax.experimental.pallas.ops.tpu.flash_attention import BlockSizes
-
-    bq = _largest_divisor_block(t)
-    bk = _largest_divisor_block(s, (512, 256, 128))
-    return BlockSizes(
-        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
-        block_q_major_dkv=bq, block_k_major_dkv=bk,
-        block_k_dkv=bk, block_q_dkv=bq,
-        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=bq,
-    )
-
-
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _pallas_flash_olm(q, k, v, causal, sm_scale, block_sizes):
     """Flash attention whose PRIMAL returns (o, l, m) — output plus the

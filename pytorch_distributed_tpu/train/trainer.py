@@ -33,7 +33,10 @@ import optax
 
 from pytorch_distributed_tpu.config import ModelConfig, TrainConfig
 from pytorch_distributed_tpu.models import ModelApi
-from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.ops.losses import (
+    cross_entropy_loss,
+    linear_cross_entropy,
+)
 from pytorch_distributed_tpu.train import checkpoint as ckpt_lib
 from pytorch_distributed_tpu.train.optim import lr_at_step, make_optimizer
 from pytorch_distributed_tpu.train.state import TrainState, init_train_state
@@ -78,6 +81,7 @@ def make_train_step(
         )
 
     def micro_loss(params, inputs, targets, key):
+        fused = model_cfg.fused_head_ce
         out = model.apply(
             params,
             inputs,
@@ -85,11 +89,29 @@ def make_train_step(
             deterministic=not train_mode,
             dropout_key=key,
             return_aux=bool(model_cfg.n_experts),
+            return_hidden=fused,
         )
-        logits, aux = out if model_cfg.n_experts else (out, 0.0)
-        if logits_sharding is not None:
-            logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
-        loss = cross_entropy_loss(logits, targets)
+        out, aux = out if model_cfg.n_experts else (out, 0.0)
+        if fused:
+            # Head matmul fused into the loss: no [B, T, V] logits tensor
+            # (ops/losses.linear_cross_entropy). logits_sharding does not
+            # apply — there are no logits to constrain.
+            hidden = out
+            w, layout = model.head_weight(params)
+            loss = linear_cross_entropy(
+                hidden.reshape(-1, hidden.shape[-1]),
+                w,
+                targets.reshape(-1),
+                w_layout=layout,
+                logits_dtype=model_cfg.logits_dtype,
+            )
+        else:
+            logits = out
+            if logits_sharding is not None:
+                logits = jax.lax.with_sharding_constraint(
+                    logits, logits_sharding
+                )
+            loss = cross_entropy_loss(logits, targets)
         if model_cfg.n_experts:
             # Switch load-balancing term (ops/moe.py).
             loss = loss + model_cfg.moe_aux_coef * aux

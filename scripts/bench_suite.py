@@ -298,8 +298,10 @@ def write_artifacts(results: dict) -> None:
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", default="1,2,3,4,5")
-    ap.add_argument("--windows", type=int, default=2)
-    ap.add_argument("--window-steps", type=int, default=6)
+    ap.add_argument("--windows", type=int, default=3)
+    # 16-step windows match bench.py: the per-window device_get fence costs
+    # a fixed relay round-trip that short windows charge to throughput.
+    ap.add_argument("--window-steps", type=int, default=16)
     ap.add_argument("--no-virtual", action="store_true")
     ap.add_argument("--virtual-row", type=int, default=None,
                     help=argparse.SUPPRESS)  # child-process entry
@@ -310,7 +312,17 @@ def main() -> None:
         return
 
     row_ids = [int(r) for r in args.rows.split(",")]
+    # Merge into any existing artifact so subset runs (--rows, --no-virtual)
+    # refresh their rows without clobbering the rest of the table.
     results: dict = {"rows": {}, "virtual": {}}
+    prior = REPO / "benchmarks" / "results.json"
+    if prior.exists():
+        try:
+            loaded = json.loads(prior.read_text())
+            results["rows"].update(loaded.get("rows", {}))
+            results["virtual"].update(loaded.get("virtual", {}))
+        except (json.JSONDecodeError, OSError):
+            pass
     for rid in row_ids:
         row = ROWS[rid]
         if row["measured"]:

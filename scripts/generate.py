@@ -1,0 +1,115 @@
+"""Text generation entry point (KV-cache decode, models/decode.py).
+
+The reference repo is training-only; this script completes the user story:
+train (or import) weights, then sample from them.
+
+Weights come from, in order of preference:
+  --checkpoint PATH   a checkpoint saved by this framework's trainer
+  --hf                pretrained HF GPT-2 (reference my_gpt2.py:292-306's
+                      from_hf_pretrained analogue; needs network/HF cache)
+  (neither)           fresh random init — smoke mode, tokens are arbitrary
+
+Token IO: with --hf (or --tokenizer) the prompt is encoded/decoded with the
+HF tokenizer; otherwise the prompt is parsed as comma-separated token ids
+and raw ids are printed (zero-egress default).
+
+Examples:
+  python scripts/generate.py --prompt-ids 1,2,3 --max-new-tokens 16
+  python scripts/generate.py --hf --prompt "The TPU is" --max-new-tokens 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    sys.path.insert(0, str(REPO))
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", default="gpt2")
+    ap.add_argument("--checkpoint", default=None)
+    ap.add_argument("--hf", action="store_true",
+                    help="load pretrained HF gpt2 weights + tokenizer")
+    ap.add_argument("--tokenizer", default=None,
+                    help="HF tokenizer name (implies text prompt IO)")
+    ap.add_argument("--prompt", default=None, help="text prompt")
+    ap.add_argument("--prompt-ids", default="0",
+                    help="comma-separated token ids (no-tokenizer mode)")
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.config import model_config
+    from pytorch_distributed_tpu.models import decode, get_model
+
+    cfg = model_config(args.preset).replace(
+        attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0
+    )
+
+    tok = None
+    if args.hf or args.tokenizer:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(args.tokenizer or "gpt2")
+
+    if args.hf:
+        from pytorch_distributed_tpu.models.hf_import import from_hf_pretrained
+
+        params, cfg = from_hf_pretrained("gpt2", cfg)
+        cfg = cfg.replace(attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0)
+    elif args.checkpoint:
+        from pytorch_distributed_tpu.train.checkpoint import load_checkpoint
+        from pytorch_distributed_tpu.train.optim import make_optimizer
+        from pytorch_distributed_tpu.config import TrainConfig
+        from pytorch_distributed_tpu.train.state import init_train_state
+
+        model = get_model(cfg)
+        tx = make_optimizer(TrainConfig(
+            global_batch_size=1, micro_batch_size=1, num_steps=1,
+            learning_rate=1e-4,
+        ))
+        template = init_train_state(
+            model.init(jax.random.key(0), cfg), tx
+        )
+        state = load_checkpoint(args.checkpoint, template)
+        params = state.params
+    else:
+        print("# no weights given: random init (smoke mode)", file=sys.stderr)
+        params = get_model(cfg).init(jax.random.key(args.seed), cfg)
+
+    if tok is not None:
+        if args.prompt is None:
+            print("--prompt TEXT required with a tokenizer", file=sys.stderr)
+            return 2
+        ids = np.asarray([tok.encode(args.prompt)], np.int32)
+    else:
+        ids = np.asarray(
+            [[int(t) for t in args.prompt_ids.split(",")]], np.int32
+        )
+
+    out = decode.generate(
+        params,
+        jax.numpy.asarray(ids),
+        cfg,
+        args.max_new_tokens,
+        temperature=args.temperature,
+        key=jax.random.key(args.seed) if args.temperature > 0 else None,
+    )
+    out = np.asarray(jax.device_get(out))[0]
+    if tok is not None:
+        print(tok.decode(out.tolist()))
+    else:
+        print(",".join(str(int(t)) for t in out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

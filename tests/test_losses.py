@@ -1,0 +1,145 @@
+"""Fused LM-head + cross-entropy (ops/losses.linear_cross_entropy) parity.
+
+The fused op must be numerically interchangeable with head-matmul +
+cross_entropy_loss — same loss, same dx, same dW — for both head
+orientations ([V, E] tied-wte and [E, V] untied) including ragged vocab
+tails, and through a full train step (config fused_head_ce=True) for both
+model families.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import ModelConfig, TrainConfig
+from pytorch_distributed_tpu.models import get_model
+from pytorch_distributed_tpu.ops.losses import (
+    cross_entropy_loss,
+    linear_cross_entropy,
+)
+from pytorch_distributed_tpu.train.optim import make_optimizer
+from pytorch_distributed_tpu.train.state import init_train_state
+from pytorch_distributed_tpu.train.trainer import make_train_step
+
+
+@pytest.mark.parametrize(
+    "n,e,v,bv,layout",
+    [
+        (64, 32, 101, 64, "ve"),  # ragged tail block
+        (64, 32, 101, 32, "ev"),
+        (64, 32, 64, 64, "ve"),  # exact fit, single block
+        (128, 48, 200, 128, "ev"),
+    ],
+)
+def test_linear_ce_matches_unfused(n, e, v, bv, layout):
+    with jax.default_matmul_precision("highest"):
+        ks = jax.random.split(jax.random.key(n + v + bv), 3)
+        x = jax.random.normal(ks[0], (n, e), jnp.float32)
+        wshape = (v, e) if layout == "ve" else (e, v)
+        w = jax.random.normal(ks[1], wshape, jnp.float32) * 0.05
+        t = jax.random.randint(ks[2], (n,), 0, v)
+        eq = "ne,ve->nv" if layout == "ve" else "ne,ev->nv"
+
+        def unfused(x, w):
+            logits = jnp.einsum(
+                eq, x, w, preferred_element_type=jnp.float32
+            )
+            return cross_entropy_loss(logits, t)
+
+        def fused(x, w):
+            return linear_cross_entropy(x, w, t, bv, layout)
+
+        np.testing.assert_allclose(
+            np.asarray(fused(x, w)), np.asarray(unfused(x, w)), atol=1e-5
+        )
+        gu = jax.grad(unfused, argnums=(0, 1))(x, w)
+        gf = jax.grad(fused, argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(
+            np.asarray(gf[0]), np.asarray(gu[0]), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(gf[1]), np.asarray(gu[1]), atol=1e-5
+        )
+
+
+def test_linear_ce_respects_logits_dtype():
+    """With bf16 hidden states and logits_dtype=float32, the fused path
+    must match the unfused head that keeps f32 logits — not the bf16-
+    rounded variant."""
+    with jax.default_matmul_precision("highest"):
+        ks = jax.random.split(jax.random.key(5), 3)
+        x = jax.random.normal(ks[0], (64, 32), jnp.bfloat16) * 3
+        w = jax.random.normal(ks[1], (101, 32), jnp.float32)
+        t = jax.random.randint(ks[2], (64,), 0, 101)
+
+        def unfused(x, w, ldt):
+            logits = jnp.einsum(
+                "ne,ve->nv", x, w.astype(x.dtype),
+                preferred_element_type=jnp.float32,
+            ).astype(ldt)
+            return cross_entropy_loss(logits, t)
+
+        f32_fused = float(
+            linear_cross_entropy(x, w, t, 64, "ve", "float32")
+        )
+        f32_ref = float(unfused(x, w, jnp.float32))
+        bf16_ref = float(unfused(x, w, jnp.bfloat16))
+        assert abs(f32_fused - f32_ref) < 1e-5
+        # the two reference precisions measurably differ, so the check
+        # above actually discriminates
+        assert abs(f32_ref - bf16_ref) > 5e-5
+
+
+def test_linear_ce_rejects_bad_layout():
+    x = jnp.zeros((4, 8))
+    w = jnp.zeros((16, 8))
+    t = jnp.zeros((4,), jnp.int32)
+    with pytest.raises(ValueError, match="w_layout"):
+        linear_cross_entropy(x, w, t, 8, "ew")
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_fused_head_ce_train_step_parity(family):
+    """A full optimizer step with fused_head_ce=True must reproduce the
+    unfused step: identical loss and post-update params (tied-wte gradient
+    flow included)."""
+    with jax.default_matmul_precision("highest"):
+        extra = {"n_kv_head": 2} if family == "llama" else {}
+        base = ModelConfig(
+            family=family, vocab_size=101, n_ctx=32, n_embd=64, n_layer=2,
+            n_head=4, dtype="float32", remat="dots", attn_pdrop=0.0,
+            resid_pdrop=0.0, embd_pdrop=0.0, **extra,
+        )
+        rng = np.random.default_rng(0)
+        batch = {
+            "inputs": jnp.asarray(
+                rng.integers(0, 101, (2, 4, 32)), jnp.int32
+            ),
+            "targets": jnp.asarray(
+                rng.integers(0, 101, (2, 4, 32)), jnp.int32
+            ),
+        }
+        results = {}
+        for fused in (False, True):
+            cfg = base.replace(fused_head_ce=fused)
+            model = get_model(cfg)
+            tx = make_optimizer(
+                TrainConfig(
+                    global_batch_size=8, micro_batch_size=4, num_steps=2,
+                    learning_rate=1e-3,
+                )
+            )
+            state = init_train_state(
+                model.init(jax.random.key(0), cfg), tx
+            )
+            step = make_train_step(model, cfg, tx, donate=False)
+            new_state, metrics = step(state, batch, jax.random.key(1))
+            results[fused] = (
+                float(metrics["loss"]),
+                jax.tree.map(np.asarray, new_state.params),
+            )
+        (l0, p0), (l1, p1) = results[False], results[True]
+        assert abs(l0 - l1) < 1e-5
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p1)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
