@@ -20,6 +20,15 @@ from __future__ import annotations
 
 import argparse
 import os
+import sys
+from pathlib import Path
+
+# Make the scripts self-contained: importing _common puts the repo root on
+# sys.path, so `pytorch_distributed_tpu` resolves even when the editable
+# pip install is absent (fresh containers).
+_REPO = str(Path(__file__).resolve().parent.parent)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 
 def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:

@@ -22,6 +22,8 @@ import argparse
 import sys
 from pathlib import Path
 
+# Repo root first so the package resolves without an editable install.
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 
