@@ -156,18 +156,26 @@ def forward(
     return logits, {"k": ck, "v": cv}
 
 
-def _sample(logits, temperature, key):
-    """[B, V] -> [B] next tokens. temperature 0 = greedy."""
+def _sample(logits, temperature, key, top_k=None):
+    """[B, V] -> [B] next tokens. temperature 0 = greedy; top_k restricts
+    sampling to the k highest-probability tokens."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        key, logits.astype(jnp.float32) / temperature, axis=-1
-    ).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        vals, idx = jax.lax.top_k(logits, top_k)  # [B, k]
+        choice = jax.random.categorical(key, vals, axis=-1)  # [B]
+        return jnp.take_along_axis(
+            idx, choice[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "temperature", "max_len"),
+    static_argnames=(
+        "cfg", "max_new_tokens", "temperature", "max_len", "top_k"
+    ),
 )
 def generate(
     params: Params,
@@ -178,6 +186,7 @@ def generate(
     temperature: float = 0.0,
     key: jax.Array | None = None,
     max_len: int | None = None,
+    top_k: int | None = None,
 ) -> jax.Array:
     """Autoregressive generation: returns [B, Tp + max_new_tokens].
 
@@ -194,7 +203,7 @@ def generate(
 
     cache = init_cache(cfg, b, max_len)
     logits, cache = forward(params, prompt, cfg, cache, 0)
-    next_tok = _sample(logits[:, -1], temperature, key)
+    next_tok = _sample(logits[:, -1], temperature, key, top_k)
 
     out = jnp.zeros((b, total), jnp.int32)
     out = jax.lax.dynamic_update_slice(out, prompt.astype(jnp.int32), (0, 0))
@@ -205,7 +214,7 @@ def generate(
         pos = tp + i
         logits, cache = forward(params, tok[:, None], cfg, cache, pos)
         nxt = _sample(
-            logits[:, -1], temperature, jax.random.fold_in(key, i)
+            logits[:, -1], temperature, jax.random.fold_in(key, i), top_k
         )
         out = out.at[:, pos + 1].set(nxt)
         return out, cache, nxt

@@ -173,6 +173,41 @@ def make_train_step(
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
 
+def make_eval_step(
+    model: ModelApi, model_cfg: ModelConfig, *, jit: bool = True
+) -> Callable:
+    """Build the jitted (params, batch) -> loss evaluation step.
+
+    Deterministic forward (no dropout) + the same cross-entropy as
+    training (fused when cfg.fused_head_ce). ``batch`` holds
+    "inputs"/"targets" of shape [B, T]. The reference downloads a
+    fineweb validation shard (reference data/data_loader.py:28-41) but
+    never evaluates on it; this closes that loop.
+    """
+
+    def eval_fn(params, batch):
+        inputs, targets = batch["inputs"], batch["targets"]
+        if inputs.ndim == 3:  # [A, B, T] (mesh-placed) -> [A*B, T]
+            inputs = inputs.reshape(-1, inputs.shape[-1])
+            targets = targets.reshape(-1, targets.shape[-1])
+        if model_cfg.fused_head_ce:
+            hidden = model.apply(
+                params, inputs, model_cfg, return_hidden=True
+            )
+            w, layout = model.head_weight(params)
+            return linear_cross_entropy(
+                hidden.reshape(-1, hidden.shape[-1]),
+                w,
+                targets.reshape(-1),
+                w_layout=layout,
+                logits_dtype=model_cfg.logits_dtype,
+            )
+        logits = model.apply(params, inputs, model_cfg)
+        return cross_entropy_loss(logits, targets)
+
+    return jax.jit(eval_fn) if jit else eval_fn
+
+
 class Trainer:
     """Single-device (or single-sharding-context) training driver.
 
@@ -331,3 +366,30 @@ class Trainer:
                 self.save_checkpoint(state)
 
         return state, history
+
+    # -- evaluation -------------------------------------------------------
+    def evaluate(
+        self,
+        state: TrainState,
+        dataloader: Iterable,
+        *,
+        max_batches: int | None = None,
+    ) -> float:
+        """Mean loss over a validation loader ([B, T] batches), with the
+        deterministic forward. Losses stay on device until one final sync."""
+        if not hasattr(self, "_eval_step"):
+            self._eval_step = make_eval_step(self.model, self.model_cfg)
+        losses: list[jax.Array] = []
+        for i, (inputs, targets) in enumerate(dataloader):
+            if max_batches is not None and i >= max_batches:
+                break
+            # [1, B, T] so mesh-aware put_batch functions (rank-3 batch
+            # sharding) work unchanged; eval_fn flattens the lead axis.
+            batch = self._put_batch(
+                {"inputs": inputs[None], "targets": targets[None]}
+            )
+            losses.append(self._eval_step(state.params, batch))
+        if not losses:
+            raise ValueError("evaluate() got an empty dataloader")
+        vals = [float(x) for x in jax.device_get(losses)]
+        return sum(vals) / len(vals)

@@ -131,6 +131,31 @@ def shard_paths(args, vocab_size: int) -> list[str]:
     )
 
 
+def val_shard_paths(args, vocab_size: int) -> list[str]:
+    """Validation data: the fineweb val shard (reference
+    data_loader.py:28-41 downloads it; nothing there ever reads it), or a
+    held-out synthetic shard from a disjoint seed."""
+    if args.data == "fineweb":
+        from pathlib import Path
+
+        from pytorch_distributed_tpu.data.download import (
+            download_fineweb10B_files,
+        )
+
+        d = os.path.join(args.data_dir, "fineweb10B")
+        download_fineweb10B_files(d, num_train_files=0)
+        return [str(Path(d) / "fineweb_val_000000.bin")]
+    from pytorch_distributed_tpu.data.synthetic import make_synthetic_shards
+
+    return make_synthetic_shards(
+        os.path.join(args.data_dir, "synthetic_val"),
+        num_shards=1,
+        tokens_per_shard=500_000,
+        vocab_size=min(vocab_size, 2**16),
+        seed=args.seed + 10_000,
+    )
+
+
 def make_profiler(args, default_trace_dir: str):
     if args.no_profiler:
         return None

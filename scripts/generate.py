@@ -41,6 +41,7 @@ def main() -> int:
                     help="comma-separated token ids (no-tokenizer mode)")
     ap.add_argument("--max-new-tokens", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -102,6 +103,7 @@ def main() -> int:
         args.max_new_tokens,
         temperature=args.temperature,
         key=jax.random.key(args.seed) if args.temperature > 0 else None,
+        top_k=args.top_k,
     )
     out = np.asarray(jax.device_get(out))[0]
     if tok is not None:

@@ -24,12 +24,18 @@ from _common import (  # noqa: E402
     make_profiler,
     setup_platform,
     shard_paths,
+    val_shard_paths,
 )
 
 
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__)
     add_common_args(p, preset="gpt2-large")
+    p.add_argument(
+        "--eval-batches", type=int, default=0,
+        help="after training, report mean val loss over this many batches "
+             "(fineweb val shard or a held-out synthetic shard); 0 = off",
+    )
     args = p.parse_args()
     setup_platform(args)
 
@@ -66,6 +72,17 @@ def main() -> int:
         if profiler is not None:
             profiler.close()
     final = history[-1] if history else {}
+    if args.eval_batches > 0:
+        val_loader = TokenShardLoader(
+            val_shard_paths(args, model_cfg.vocab_size),
+            args.micro_batch_size,
+            args.seq_len,
+        )
+        val_loss = trainer.evaluate(
+            state, val_loader, max_batches=args.eval_batches
+        )
+        final = {**final, "val_loss": val_loss}
+        log.info(f"val loss ({args.eval_batches} batches): {val_loss:.4f}")
     log.info(f"done: {final}")
     return 0
 

@@ -108,3 +108,17 @@ def test_cache_rejects_overlong():
     cfg = _cfg("gpt2")
     with pytest.raises(ValueError, match="n_ctx"):
         decode.init_cache(cfg, 1, cfg.n_ctx + 1)
+
+
+def test_generate_top_k_restricts_support():
+    """With top_k=1, temperature sampling must equal greedy decoding."""
+    cfg = _cfg("gpt2")
+    model = get_model(cfg)
+    params = model.init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, cfg.vocab_size)
+    greedy = decode.generate(params, prompt, cfg, 5)
+    topk1 = decode.generate(
+        params, prompt, cfg, 5, temperature=1.0, key=jax.random.key(9),
+        top_k=1,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))

@@ -170,3 +170,52 @@ def test_trailing_partial_accum_window_dropped(tiny_config):
     trainer, _ = _trainer(tiny_config, num_steps=5)
     state, _ = trainer.train(iter(micro))
     assert int(state.step) == 1
+
+
+def test_evaluate_mean_loss(tiny_config):
+    """Trainer.evaluate = mean deterministic CE over the loader, and a
+    trained model evaluates better than an untrained one."""
+    from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+
+    cfg_nodrop = tiny_config.replace(
+        embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0
+    )
+    trainer, _ = _trainer(cfg_nodrop, num_steps=10)
+    rng = np.random.default_rng(3)
+    batches = [
+        (
+            rng.integers(0, 101, (4, 16)).astype(np.int32),
+            rng.integers(0, 101, (4, 16)).astype(np.int32),
+        )
+        for _ in range(3)
+    ]
+    state = trainer.init_state()
+    got = trainer.evaluate(state, batches)
+    model = get_model(cfg_nodrop)
+    expect = float(
+        np.mean(
+            [
+                float(
+                    cross_entropy_loss(
+                        model.apply(state.params, jnp.asarray(x), cfg_nodrop),
+                        jnp.asarray(y),
+                    )
+                )
+                for x, y in batches
+            ]
+        )
+    )
+    assert got == pytest.approx(expect, rel=1e-5)
+
+    # max_batches respected
+    one = trainer.evaluate(state, batches, max_batches=1)
+    assert one != pytest.approx(got, rel=1e-6) or len(batches) == 1
+
+    # training on the (repeated) eval data lowers eval loss
+    state2, _ = trainer.train(
+        iter(batches * 10), state=state, num_steps=10
+    )
+    assert trainer.evaluate(state2, batches) < got
+
+    with pytest.raises(ValueError, match="empty"):
+        trainer.evaluate(state, [])
