@@ -58,8 +58,15 @@ class DistributedTrainer(Trainer):
         path: str = "auto",
         log_fn: Callable[[str], None] | None = None,
     ):
-        if path not in ("auto", "explicit"):
+        if path not in ("auto", "explicit", "pipeline"):
             raise ValueError(f"unknown parallel path {path!r}")
+        if path == "pipeline" and mesh_cfg.pipe <= 1:
+            raise ValueError("path='pipeline' requires a pipe>1 mesh axis")
+        if path == "pipeline" and model_cfg.n_layer % mesh_cfg.pipe:
+            raise ValueError(
+                f"pipeline stages must divide the layer stack: n_layer="
+                f"{model_cfg.n_layer} vs pipe={mesh_cfg.pipe}"
+            )
         self.mesh = mesh
         self.mesh_cfg = mesh_cfg
         self.path = path
@@ -86,6 +93,18 @@ class DistributedTrainer(Trainer):
     def init_state(self, init_key=None) -> TrainState:
         """Initialise and shard the train state; builds the parallel step."""
         state = super().init_state(init_key)
+        if self.path == "pipeline":
+            from pytorch_distributed_tpu.parallel.pipeline import (
+                make_pipeline_train_step,
+                shard_pipeline_state,
+            )
+
+            state, _ = shard_pipeline_state(state, self.mesh, self.mesh_cfg)
+            self.train_step = make_pipeline_train_step(
+                self.model, self.model_cfg, self.tx, self.mesh,
+                self.mesh_cfg, state, self.train_cfg,
+            )
+            return state
         state, _ = shard_train_state(state, self.mesh, self.mesh_cfg)
         if self.path == "explicit":
             self.train_step = make_explicit_train_step(

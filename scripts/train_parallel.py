@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""General mesh-parallel training: any combination of the six mesh axes.
+
+Beyond the reference's DDP/FSDP surface (scripts/train_ddp.py,
+scripts/train_fsdp.py), this entry exposes the framework's full parallelism
+set from the CLI:
+
+  --mesh data=2,fsdp=2,tensor=2      pjit/NamedSharding (auto) or explicit
+                                     shard_map collectives (--path explicit)
+  --mesh fsdp=2,seq=4 --path explicit   ring-attention context parallelism
+  --mesh pipe=4,data=2 --path pipeline  GPipe pipeline schedule
+  --mesh expert=4,data=2 --n-experts 4  MoE expert parallelism
+
+Cluster-free: run any of these on a virtual CPU mesh with --cpu-devices N
+(SURVEY.md §4's testing contract). On a real pod, jax.distributed
+initialisation and per-process data slicing follow scripts/train_fsdp.py.
+
+Examples:
+  python scripts/train_parallel.py --preset tiny --seq-len 64 \\
+      --cpu-devices 8 --mesh data=2,fsdp=2,tensor=2 \\
+      --global-batch-size 16 --micro-batch-size 2 --steps 4
+  python scripts/train_parallel.py --preset tiny --seq-len 64 \\
+      --cpu-devices 8 --mesh pipe=4,data=2 --path pipeline \\
+      --global-batch-size 16 --micro-batch-size 2 --steps 4 --no-dropout
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+from _common import (  # noqa: E402
+    add_common_args,
+    build_model_cfg,
+    build_train_cfg,
+    make_profiler,
+    setup_platform,
+    shard_paths,
+)
+
+_AXES = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+
+def parse_mesh(spec: str) -> dict:
+    out = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        name, _, val = part.partition("=")
+        if name not in _AXES:
+            raise SystemExit(
+                f"unknown mesh axis {name!r}; known: {', '.join(_AXES)}"
+            )
+        out[name] = int(val)
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    add_common_args(p, preset="tiny")
+    p.add_argument(
+        "--mesh", default="data=8",
+        help="comma-separated axis=size (pipe, data, fsdp, expert, seq, "
+             "tensor); product must equal the device count",
+    )
+    p.add_argument(
+        "--strategy", default="full_shard",
+        choices=["full_shard", "shard_grad_op", "no_shard"],
+    )
+    p.add_argument(
+        "--path", default="auto", choices=["auto", "explicit", "pipeline"]
+    )
+    p.add_argument("--n-experts", type=int, default=0)
+    p.add_argument(
+        "--no-dropout", action="store_true",
+        help="zero all dropout (required for seq/pipeline paths)",
+    )
+    args = p.parse_args()
+    setup_platform(args)
+
+    import jax
+
+    from pytorch_distributed_tpu.config import MeshConfig
+    from pytorch_distributed_tpu.data import DistributedTokenShardLoader
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.mesh import (
+        data_parallel_size,
+        initialize_distributed,
+    )
+    from pytorch_distributed_tpu.train.distributed_trainer import (
+        DistributedTrainer,
+    )
+    from pytorch_distributed_tpu.utils.logging import get_logger
+
+    initialize_distributed()
+    log = get_logger("pdtpu.parallel")
+
+    axes = parse_mesh(args.mesh)
+    n_devices = len(jax.devices())
+    import math
+
+    if math.prod(axes.values()) != n_devices:
+        raise SystemExit(
+            f"mesh {axes} covers {math.prod(axes.values())} devices, "
+            f"but {n_devices} are visible"
+        )
+    mesh_cfg = MeshConfig(**axes, strategy=args.strategy)
+    mesh = make_mesh(mesh_cfg)
+
+    model_cfg = build_model_cfg(args)
+    if args.n_experts:
+        model_cfg = model_cfg.replace(n_experts=args.n_experts)
+    if args.no_dropout or mesh_cfg.seq > 1 or args.path == "pipeline":
+        model_cfg = model_cfg.replace(
+            embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0
+        )
+
+    dp = data_parallel_size(mesh_cfg)
+    train_cfg = build_train_cfg(args, data_parallel_size=dp)
+    model = get_model(model_cfg)
+
+    paths = shard_paths(args, model_cfg.vocab_size)
+    local_rows = args.micro_batch_size * (dp // jax.process_count())
+    loader = DistributedTokenShardLoader(
+        paths,
+        max(local_rows, 1),
+        args.seq_len,
+        rank=jax.process_index(),
+        world_size=jax.process_count(),
+    )
+    log.info(
+        f"mesh={dict(mesh_cfg.shape)} path={args.path} "
+        f"strategy={args.strategy} accum={train_cfg.grad_accum_steps(dp)}"
+    )
+
+    trainer = DistributedTrainer(
+        model, model_cfg, train_cfg, mesh, mesh_cfg, path=args.path
+    )
+    profiler = make_profiler(args, "outputs/traces/parallel")
+    try:
+        state, history = trainer.train(loader, profiler=profiler)
+    finally:
+        if profiler is not None:
+            profiler.close()
+    log.info(f"done: {history[-1] if history else {}}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
