@@ -133,6 +133,46 @@ def save_memory_snapshot(path: str | Path) -> str | None:
     return str(path)
 
 
+def compiled_memory_analysis(fn, *example_args) -> dict | None:
+    """Exact compile-time HBM accounting from XLA's buffer assignment.
+
+    Lowers + compiles ``fn`` on the example arguments and returns the
+    compiler's memory numbers — the same figures an HBM OOM error reports
+    ("Program hbm requirement ..."), available BEFORE running anything.
+    Unlike ``measured_memory`` this works on backends with no runtime
+    memory stats (the relay TPU), and is the idiomatic TPU answer to the
+    reference's allocator-history accounting (SURVEY.md §2.3: HLO
+    buffer-assignment dump). Returns None if the backend or jax version
+    does not expose the analysis.
+    """
+    try:
+        # Already-jitted callables lower directly (preserving donation /
+        # aliasing); plain functions get wrapped.
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        compiled = jitted.lower(*example_args).compile()
+        ma = compiled.memory_analysis()
+    except (AttributeError, NotImplementedError, jax.errors.JaxRuntimeError):
+        return None
+    if ma is None:
+        return None
+    return {
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "alias_bytes": int(ma.alias_size_in_bytes),
+        "code_bytes": int(ma.generated_code_size_in_bytes),
+        "peak_bytes": int(getattr(ma, "peak_memory_in_bytes", 0)),
+        # What must fit in HBM simultaneously: live args (minus donated
+        # aliases) + outputs + program temporaries.
+        "total_bytes": int(
+            ma.argument_size_in_bytes
+            - ma.alias_size_in_bytes
+            + ma.output_size_in_bytes
+            + ma.temp_size_in_bytes
+        ),
+    }
+
+
 def compare_estimate_vs_measured(
     cfg: ModelConfig, *, batch_size: int = 8, seq_len: int = 1024
 ) -> dict:

@@ -58,6 +58,10 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
     p.add_argument("--trace-dir", default=None)
     p.add_argument("--cpu-devices", type=int, default=0,
                    help="force CPU platform with this many virtual devices")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="jax_debug_nans: error at the op that first "
+                        "produces a NaN (the functional-JAX analogue of "
+                        "torch.autograd.detect_anomaly — SURVEY.md §5.2)")
 
 
 def setup_platform(args) -> None:
@@ -71,6 +75,10 @@ def setup_platform(args) -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if getattr(args, "debug_nans", False):
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
 
 
 def build_model_cfg(args):

@@ -48,6 +48,7 @@ def main() -> int:
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.profiling.memory import (
         analytic_memory_breakdown,
+        compiled_memory_analysis,
         measured_memory,
         save_memory_snapshot,
     )
@@ -85,6 +86,19 @@ def main() -> int:
         ),
     }
     dkey = domain_key(args.seed, "dropout")
+
+    xla = compiled_memory_analysis(step, state, batch, dkey)
+    if xla is not None:
+        print("\n=== compiled program (XLA buffer assignment) ===")
+        print(f"arguments:  {_fmt(xla['argument_bytes'])} "
+              f"(donated/aliased: {_fmt(xla['alias_bytes'])})")
+        print(f"outputs:    {_fmt(xla['output_bytes'])}")
+        print(f"HLO temps:  {_fmt(xla['temp_bytes'])}")
+        print(f"TOTAL live: {_fmt(xla['total_bytes'])} "
+              f"-- exact pre-flight HBM requirement for one train step")
+        ratio = xla["total_bytes"] / est["total_bytes_estimate"]
+        print(f"xla/estimated: {ratio:.2f}x")
+
     for i in range(args.profile_steps):
         state, metrics = step(state, batch, jax.random.fold_in(dkey, i))
         loss = float(jax.device_get(metrics["loss"]))
