@@ -40,11 +40,12 @@ def main() -> None:
     from pytorch_distributed_tpu.utils.prng import domain_key
 
     batch_size, seq_len = 8, 1024
-    # 16-step windows: the only reliable fence on this platform is a
+    # 48-step windows: the only reliable fence on this platform is a
     # device_get per window, whose relay round-trip is a fixed per-window
-    # cost — short windows understate the device rate (measured ~15 ms/step
-    # of apparent overhead at 8-step windows vs the device trace).
-    warmup_steps, window_steps, num_windows = 3, 16, 3
+    # cost — short windows understate the device rate (8-step windows read
+    # ~15 ms/step of pure fencing; by 48 steps the number converges on the
+    # device-trace step time, ~77.6 ms for this config).
+    warmup_steps, window_steps, num_windows = 3, 48, 3
 
     seed = int.from_bytes(os.urandom(4), "little")
 

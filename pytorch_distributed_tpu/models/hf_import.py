@@ -178,6 +178,74 @@ def to_hf_gpt2_state_dict(params: dict) -> dict:
     return out
 
 
+_HF_LLAMA_BLOCK_KEYS = {
+    # HF torch-Linear [out, in] -> our [in, out] kernels: all transposed.
+    "input_layernorm.weight": ("ln_attn", "scale"),
+    "self_attn.q_proj.weight": ("attn", "wq"),
+    "self_attn.k_proj.weight": ("attn", "wk"),
+    "self_attn.v_proj.weight": ("attn", "wv"),
+    "self_attn.o_proj.weight": ("attn", "wo"),
+    "post_attention_layernorm.weight": ("ln_mlp", "scale"),
+    "mlp.gate_proj.weight": ("mlp", "gate"),
+    "mlp.up_proj.weight": ("mlp", "up"),
+    "mlp.down_proj.weight": ("mlp", "down"),
+}
+
+
+def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
+    """Convert an HF LlamaForCausalLM state dict to our llama params.
+
+    All projections are torch Linear [out, in] and transpose to our
+    [in, out] kernels. Head ordering and the half-split RoPE convention
+    match HF exactly (ops/rope.py), so no permutations are needed. Tied-
+    embedding checkpoints (no ``lm_head.weight``, e.g. Llama-3.2 1B) reuse
+    ``embed_tokens`` for the head.
+    """
+    sd = {k: _to_np(v) for k, v in sd.items()}
+    sd = {
+        (k[len("model.") :] if k.startswith("model.") else k): v
+        for k, v in sd.items()
+    }
+    dtype = np.dtype(cfg.param_dtype)
+
+    wte = sd["embed_tokens.weight"].astype(dtype)
+    if wte.shape != (cfg.vocab_size, cfg.n_embd):
+        raise ValueError(
+            f"embed_tokens shape {wte.shape} != "
+            f"({cfg.vocab_size}, {cfg.n_embd})"
+        )
+    lm_head = sd.get("lm_head.weight", sd["embed_tokens.weight"])
+    params: dict = {
+        "wte": wte,
+        "ln_f": {"scale": sd["norm.weight"].astype(dtype)},
+        "lm_head": lm_head.T.astype(dtype),
+        "blocks": {},
+    }
+
+    for hf_key, path in _HF_LLAMA_BLOCK_KEYS.items():
+        per_layer = []
+        for layer in range(cfg.n_layer):
+            name = f"layers.{layer}.{hf_key}"
+            if name not in sd:
+                raise KeyError(f"missing {name!r} in state dict")
+            arr = sd[name]
+            if hf_key.endswith("proj.weight"):
+                arr = arr.T  # Linear [out, in] -> kernel [in, out]
+            per_layer.append(arr)
+        _set_nested(
+            params["blocks"], path, np.stack(per_layer).astype(dtype)
+        )
+
+    got = params["blocks"]["attn"]["wk"].shape
+    expect = (cfg.n_layer, cfg.n_embd, cfg.kv_heads * cfg.head_dim)
+    if got != expect:
+        raise ValueError(
+            f"wk stacked shape {got} != {expect} — config kv_heads/head_dim "
+            "mismatch with the checkpoint"
+        )
+    return params
+
+
 def from_hf_pretrained(model_name: str = "gpt2", cfg: ModelConfig | None = None):
     """Download HF GPT-2 weights and convert (reference
     from_hf_pretrained, my_gpt2.py:292-306). Needs network + transformers;

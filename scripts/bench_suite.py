@@ -299,9 +299,10 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", default="1,2,3,4,5")
     ap.add_argument("--windows", type=int, default=3)
-    # 16-step windows match bench.py: the per-window device_get fence costs
-    # a fixed relay round-trip that short windows charge to throughput.
-    ap.add_argument("--window-steps", type=int, default=16)
+    # 48-step windows match bench.py: the per-window device_get fence costs
+    # a fixed relay round-trip that short windows charge to throughput; by
+    # 48 steps the number converges on the device-trace step time.
+    ap.add_argument("--window-steps", type=int, default=48)
     ap.add_argument("--no-virtual", action="store_true")
     ap.add_argument("--virtual-row", type=int, default=None,
                     help=argparse.SUPPRESS)  # child-process entry
