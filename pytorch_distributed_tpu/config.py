@@ -136,6 +136,11 @@ _GPT2_PRESETS: dict[str, dict[str, int]] = {
     "gpt2-large": dict(n_embd=1280, n_layer=36, n_head=20),  # 774M
     "gpt2-xl": dict(n_embd=1600, n_layer=48, n_head=25),  # 1.56B
     "gpt2-1p3b": dict(n_embd=2048, n_layer=24, n_head=16),  # 1.31B
+    # Smoke-test shape for CPU runs and CLI examples.
+    "tiny": dict(
+        vocab_size=256, n_ctx=128, n_embd=64, n_layer=2, n_head=4,
+        dtype="float32",
+    ),
 }
 
 _LLAMA_PRESETS: dict[str, dict[str, Any]] = {

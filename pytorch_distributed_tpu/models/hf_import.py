@@ -247,22 +247,39 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
 
 
 def from_hf_pretrained(model_name: str = "gpt2", cfg: ModelConfig | None = None):
-    """Download HF GPT-2 weights and convert (reference
-    from_hf_pretrained, my_gpt2.py:292-306). Needs network + transformers;
-    in zero-egress environments convert a local state dict via
-    ``from_hf_gpt2_state_dict`` instead."""
+    """Download HF weights and convert (reference from_hf_pretrained,
+    my_gpt2.py:292-306, generalised to both families: gpt2-style and
+    llama-style checkpoints are detected from the HF config). Needs
+    network + transformers; in zero-egress environments convert a local
+    state dict via ``from_hf_gpt2_state_dict`` /
+    ``from_hf_llama_state_dict`` instead."""
     from transformers import AutoConfig, AutoModelForCausalLM
 
     from pytorch_distributed_tpu.config import model_config
 
+    hf_cfg = AutoConfig.from_pretrained(model_name)
+    is_llama = hf_cfg.model_type in ("llama", "mistral")
     if cfg is None:
-        hf_cfg = AutoConfig.from_pretrained(model_name)
-        cfg = model_config("gpt2").replace(
-            vocab_size=hf_cfg.vocab_size,
-            n_ctx=hf_cfg.n_positions,
-            n_embd=hf_cfg.n_embd,
-            n_layer=hf_cfg.n_layer,
-            n_head=hf_cfg.n_head,
-        )
+        if is_llama:
+            cfg = model_config("llama3-1b").replace(
+                vocab_size=hf_cfg.vocab_size,
+                n_ctx=hf_cfg.max_position_embeddings,
+                n_embd=hf_cfg.hidden_size,
+                n_layer=hf_cfg.num_hidden_layers,
+                n_head=hf_cfg.num_attention_heads,
+                n_kv_head=hf_cfg.num_key_value_heads,
+                n_inner=hf_cfg.intermediate_size,
+                rope_theta=hf_cfg.rope_theta,
+                layer_norm_epsilon=hf_cfg.rms_norm_eps,
+            )
+        else:
+            cfg = model_config("gpt2").replace(
+                vocab_size=hf_cfg.vocab_size,
+                n_ctx=hf_cfg.n_positions,
+                n_embd=hf_cfg.n_embd,
+                n_layer=hf_cfg.n_layer,
+                n_head=hf_cfg.n_head,
+            )
     model = AutoModelForCausalLM.from_pretrained(model_name)
-    return from_hf_gpt2_state_dict(model.state_dict(), cfg), cfg
+    convert = from_hf_llama_state_dict if is_llama else from_hf_gpt2_state_dict
+    return convert(model.state_dict(), cfg), cfg

@@ -82,15 +82,11 @@ def setup_platform(args) -> None:
 
 
 def build_model_cfg(args):
-    from pytorch_distributed_tpu.config import ModelConfig, model_config
+    from pytorch_distributed_tpu.config import model_config
 
+    cfg = model_config(args.preset)
     if args.preset == "tiny":
-        cfg = ModelConfig(
-            vocab_size=256, n_ctx=max(args.seq_len, 32), n_embd=64,
-            n_layer=2, n_head=4, dtype="float32",
-        )
-    else:
-        cfg = model_config(args.preset)
+        cfg = cfg.replace(n_ctx=max(args.seq_len, 32))
     if args.dtype:
         cfg = cfg.replace(dtype=args.dtype)
     if args.seq_len > cfg.n_ctx:

@@ -5,8 +5,9 @@ train (or import) weights, then sample from them.
 
 Weights come from, in order of preference:
   --checkpoint PATH   a checkpoint saved by this framework's trainer
-  --hf                pretrained HF GPT-2 (reference my_gpt2.py:292-306's
-                      from_hf_pretrained analogue; needs network/HF cache)
+  --hf MODEL          pretrained HF weights, gpt2- or llama-style
+                      (reference my_gpt2.py:292-306's from_hf_pretrained
+                      analogue; needs network/HF cache)
   (neither)           fresh random init — smoke mode, tokens are arbitrary
 
 Token IO: with --hf (or --tokenizer) the prompt is encoded/decoded with the
@@ -15,7 +16,8 @@ and raw ids are printed (zero-egress default).
 
 Examples:
   python scripts/generate.py --prompt-ids 1,2,3 --max-new-tokens 16
-  python scripts/generate.py --hf --prompt "The TPU is" --max-new-tokens 32
+  python scripts/generate.py --hf gpt2 --prompt "The TPU is" --top-k 40 \\
+      --temperature 0.8
 """
 
 from __future__ import annotations
@@ -31,9 +33,13 @@ def main() -> int:
     sys.path.insert(0, str(REPO))
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="gpt2")
+    ap.add_argument("--n-ctx", type=int, default=0,
+                    help="override the preset's context length (must match "
+                         "the checkpoint's position table)")
     ap.add_argument("--checkpoint", default=None)
-    ap.add_argument("--hf", action="store_true",
-                    help="load pretrained HF gpt2 weights + tokenizer")
+    ap.add_argument("--hf", default=None, metavar="MODEL",
+                    help="load pretrained HF weights + tokenizer (gpt2- or "
+                         "llama-style checkpoints, e.g. 'gpt2')")
     ap.add_argument("--tokenizer", default=None,
                     help="HF tokenizer name (implies text prompt IO)")
     ap.add_argument("--prompt", default=None, help="text prompt")
@@ -54,17 +60,19 @@ def main() -> int:
     cfg = model_config(args.preset).replace(
         attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0
     )
+    if args.n_ctx:
+        cfg = cfg.replace(n_ctx=args.n_ctx)
 
     tok = None
     if args.hf or args.tokenizer:
         from transformers import AutoTokenizer
 
-        tok = AutoTokenizer.from_pretrained(args.tokenizer or "gpt2")
+        tok = AutoTokenizer.from_pretrained(args.tokenizer or args.hf)
 
     if args.hf:
         from pytorch_distributed_tpu.models.hf_import import from_hf_pretrained
 
-        params, cfg = from_hf_pretrained("gpt2", cfg)
+        params, cfg = from_hf_pretrained(args.hf, None)
         cfg = cfg.replace(attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0)
     elif args.checkpoint:
         from pytorch_distributed_tpu.train.checkpoint import load_checkpoint
