@@ -81,8 +81,9 @@ class ModelConfig:
 
     # Mixture-of-Experts (ops/moe.py): 0 = dense MLP (reference behavior);
     # >0 replaces each block's MLP with n_experts expert MLPs and a top-1
-    # router (gpt2 family). Aux-loss coefficient weights the Switch
-    # load-balancing term added to the training objective.
+    # router — dense-style experts for gpt2, SwiGLU experts for llama.
+    # Aux-loss coefficient weights the Switch load-balancing term added to
+    # the training objective.
     n_experts: int = 0
     expert_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
@@ -101,9 +102,9 @@ class ModelConfig:
                 f"unknown attention_impl: {self.attention_impl!r} "
                 "(implemented: naive, flash)"
             )
-        if self.n_experts and self.family != "gpt2":
+        if self.n_experts and self.family not in ("gpt2", "llama"):
             raise ValueError(
-                "MoE (n_experts > 0) is implemented for the gpt2 family"
+                "MoE (n_experts > 0) requires the gpt2 or llama family"
             )
 
     @property

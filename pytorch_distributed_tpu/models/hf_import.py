@@ -201,6 +201,11 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
     embedding checkpoints (no ``lm_head.weight``, e.g. Llama-3.2 1B) reuse
     ``embed_tokens`` for the head.
     """
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "HF llama import targets dense checkpoints; MoE configs "
+            "(n_experts > 0) have no HF-side weight mapping here"
+        )
     sd = {k: _to_np(v) for k, v in sd.items()}
     sd = {
         (k[len("model.") :] if k.startswith("model.") else k): v

@@ -58,18 +58,37 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
                 "wo": normal(keys[4], (l, h * d, e)),
             },
             "ln_mlp": {"scale": jnp.ones((l, e), pdt)},
-            "mlp": {
-                "gate": normal(keys[5], (l, e, f)),
-                "up": normal(keys[6], (l, e, f)),
-                "down": normal(keys[7], (l, f, e)),
-            },
+            "mlp": (
+                {
+                    "gate": normal(keys[5], (l, e, f)),
+                    "up": normal(keys[6], (l, e, f)),
+                    "down": normal(keys[7], (l, f, e)),
+                }
+                if not cfg.n_experts
+                else {
+                    # Switch-routed SwiGLU experts (ops/moe.py): per-layer
+                    # router + stacked expert gate/up/down weights.
+                    "router": normal(
+                        jax.random.fold_in(keys[5], 1), (l, e, cfg.n_experts)
+                    ),
+                    "w_gate": normal(
+                        keys[5], (l, cfg.n_experts, e, f)
+                    ),
+                    "w_in": normal(keys[6], (l, cfg.n_experts, e, f)),
+                    "w_out": normal(keys[7], (l, cfg.n_experts, f, e)),
+                }
+            ),
         },
         "ln_f": {"scale": jnp.ones((e,), pdt)},
         "lm_head": normal(jax.random.fold_in(keys[0], 1), (e, v)),
     }
 
 
-def _block(x, bp, cfg: ModelConfig, cos, sin, seq_axis=None, tensor_axis=None):
+def _block(
+    x, bp, cfg: ModelConfig, cos, sin, seq_axis=None, tensor_axis=None,
+    expert_axis=None,
+):
+    """Returns (x, moe_aux_loss) — the aux term is zero for dense MLPs."""
     eps = cfg.layer_norm_epsilon
     b, t = x.shape[:2]
     d = cfg.head_dim
@@ -97,6 +116,18 @@ def _block(x, bp, cfg: ModelConfig, cos, sin, seq_axis=None, tensor_axis=None):
     )
 
     m = rms_norm(x, bp["ln_mlp"], eps=eps)
+    if cfg.n_experts:
+        from pytorch_distributed_tpu.ops.moe import moe_mlp
+
+        m, aux = moe_mlp(
+            m,
+            bp["mlp"],
+            activation=jax.nn.silu,
+            capacity_factor=cfg.expert_capacity_factor,
+            expert_axis=expert_axis,
+        )
+        return x + m, aux
+    aux = jnp.zeros((), jnp.float32)
     m = tp_copy(m, tensor_axis)
     gate = jax.nn.silu(
         checkpoint_name(m @ bp["mlp"]["gate"].astype(m.dtype), "mlp_gate")
@@ -108,7 +139,7 @@ def _block(x, bp, cfg: ModelConfig, cos, sin, seq_axis=None, tensor_axis=None):
         ),
         "mlp_proj",
     )
-    return x
+    return x, aux
 
 
 def apply(
@@ -131,9 +162,10 @@ def apply(
     ``seq_axis`` — sequence-sharded (context-parallel) call: RoPE angles are
     offset by the shard's global start and attention runs the ring kernel.
     ``tensor_axis`` — explicit Megatron TP, see models/gpt2.py.
-    ``expert_axis``/``return_aux`` — MoE is gpt2-only (config validation
-    rejects llama n_experts>0); accepted for API uniformity."""
-    del dropout_key, deterministic, expert_axis
+    ``expert_axis``/``return_aux`` — Switch-routed SwiGLU MoE
+    (cfg.n_experts > 0, ops/moe.py); the aux value is the summed Switch
+    load-balancing loss over layers (zero for dense configs)."""
+    del dropout_key, deterministic
     b, t = input_ids.shape
     # Global length under sequence sharding (shards × local t): RoPE would
     # silently extrapolate past the trained context window otherwise.
@@ -151,12 +183,24 @@ def apply(
     cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta, offset=offset)
 
     def scan_body(carry, bp):
+        h, aux_sum = carry
         if block_transform is not None:
             bp = block_transform(bp)
-        return _block(carry, bp, cfg, cos, sin, seq_axis, tensor_axis), None
+        h, aux = _block(
+            h, bp, cfg, cos, sin, seq_axis, tensor_axis, expert_axis
+        )
+        return (h, aux_sum + aux), None
 
     body = apply_remat(scan_body, cfg.remat)
-    x, _ = jax.lax.scan(body, x, params["blocks"])
+    # The aux carry must match the activations' varying axes under
+    # shard_map (see models/gpt2.py).
+    from pytorch_distributed_tpu.ops.tp import pvary_missing
+
+    aux0 = pvary_missing(
+        jnp.zeros((), jnp.float32),
+        tuple(getattr(jax.typeof(x), "vma", frozenset())),
+    )
+    (x, aux_total), _ = jax.lax.scan(body, (x, aux0), params["blocks"])
     if return_hidden:
         # Final-norm hidden states for the fused head+CE loss (see
         # models/gpt2.py apply docstring).
@@ -164,7 +208,7 @@ def apply(
     else:
         out = head(params, x, cfg)
     if return_aux:
-        return out, jnp.zeros((), jnp.float32)
+        return out, aux_total
     return out
 
 
@@ -183,7 +227,8 @@ def run_blocks(blocks: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
     cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta)
 
     def body(carry, bp):
-        return _block(carry, bp, cfg, cos, sin), None
+        h, _aux = _block(carry, bp, cfg, cos, sin)
+        return h, None
 
     x, _ = jax.lax.scan(apply_remat(body, cfg.remat), x, blocks)
     return x

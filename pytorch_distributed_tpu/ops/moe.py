@@ -47,7 +47,8 @@ def expert_capacity(
 
 def moe_mlp(
     x: jax.Array,  # [B, T, D]
-    params: dict,  # router [D, X]; w_in [X, D, F]; w_out [X, F, D]
+    params: dict,  # router [D, X]; w_in [X, D, F]; w_out [X, F, D];
+    #               optional w_gate [X, D, F] (SwiGLU experts)
     *,
     activation,
     capacity_factor: float = 1.25,
@@ -98,11 +99,22 @@ def moe_mlp(
             expert_in, expert_axis, split_axis=0, concat_axis=1, tiled=True
         )  # [X/n, n*C, D]
 
-    # --- expert compute: one batched matmul pair -------------------------
+    # --- expert compute: batched matmuls ---------------------------------
+    # Dense-style experts: act(x @ w_in) @ w_out (gpt2 family).
+    # Gated (SwiGLU) experts, params include "w_gate":
+    # (act(x @ w_gate) * (x @ w_in)) @ w_out (llama family; w_in is the
+    # up-projection).
     h = jnp.einsum(
         "xcd,xdf->xcf", expert_in, params["w_in"].astype(expert_in.dtype)
     )
-    h = activation(h)
+    if "w_gate" in params:
+        g = jnp.einsum(
+            "xcd,xdf->xcf", expert_in,
+            params["w_gate"].astype(expert_in.dtype),
+        )
+        h = activation(g) * h
+    else:
+        h = activation(h)
     expert_out = jnp.einsum(
         "xcf,xfd->xcd", h, params["w_out"].astype(h.dtype)
     )

@@ -64,6 +64,7 @@ _TENSOR_SUFFIX_LENS = (3, 2)
 # expert dim over the "expert" axis; the router stays replicated.
 _EXPERT_RULES: dict[tuple[str, ...], int] = {
     ("mlp", "w_in"): 1,
+    ("mlp", "w_gate"): 1,
     ("mlp", "w_out"): 1,
 }
 

@@ -25,12 +25,15 @@ from pytorch_distributed_tpu.train.trainer import make_train_step
 from pytorch_distributed_tpu.utils.prng import domain_key
 
 
-def _moe_cfg(**kw):
+def _moe_cfg(family="gpt2", **kw):
     base = dict(
+        family=family,
         vocab_size=128, n_ctx=16, n_embd=64, n_layer=2, n_head=4,
         dtype="float32", embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
         n_experts=4, expert_capacity_factor=8.0,  # generous: nothing drops
     )
+    if family == "llama":
+        base["n_kv_head"] = 2
     base.update(kw)
     return ModelConfig(**base)
 
@@ -68,8 +71,9 @@ def test_moe_capacity_drops_tokens():
     assert nonzero_tokens <= 1
 
 
-def test_moe_gpt2_trains():
-    cfg = _moe_cfg()
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_moe_model_trains(family):
+    cfg = _moe_cfg(family)
     model = get_model(cfg)
     tcfg = TrainConfig(
         global_batch_size=8, micro_batch_size=8, num_steps=30,
@@ -89,9 +93,9 @@ def test_moe_gpt2_trains():
     assert losses[-1] < losses[0] - 0.5, losses[::10]
 
 
-def _ep_reference(moe_aux_coef=0.0):
+def _ep_reference(moe_aux_coef=0.0, family="gpt2"):
     """Shared setup for the EP parity tests: (cfg, model, tx, batch, ref)."""
-    cfg = _moe_cfg(moe_aux_coef=moe_aux_coef)
+    cfg = _moe_cfg(family, moe_aux_coef=moe_aux_coef)
     model = get_model(cfg)
     tcfg = TrainConfig(
         global_batch_size=16, micro_batch_size=16, num_steps=1,
@@ -122,13 +126,18 @@ def _assert_matches_ref(new_state, m, ref_state, ref_m):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
-@pytest.mark.parametrize("expert,data", [(4, 1), (2, 2), (4, 2)])
-def test_expert_parallel_matches_single_device(eight_devices, expert, data):
+@pytest.mark.parametrize(
+    "expert,data,family",
+    [(4, 1, "gpt2"), (2, 2, "gpt2"), (4, 2, "gpt2"), (4, 2, "llama")],
+)
+def test_expert_parallel_matches_single_device(
+    eight_devices, expert, data, family
+):
     # aux coef 0 for EXACT parity: the load-balancing term is computed per
     # token-shard and averaged under EP (the standard distributed-Switch
     # convention), which differs from the global-batch product by O(1e-4) -
     # test_expert_parallel_aux_close covers the aux-on case.
-    cfg, model, tx, batch, ref_state, ref_m = _ep_reference()
+    cfg, model, tx, batch, ref_state, ref_m = _ep_reference(family=family)
     mcfg = MeshConfig(expert=expert, data=data, strategy="no_shard")
     mesh = make_mesh(mcfg)
     state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
@@ -156,13 +165,15 @@ def test_expert_parallel_aux_close(eight_devices):
     assert float(m["loss"]) == pytest.approx(float(ref_m["loss"]), abs=1e-3)
 
 
-def test_pjit_moe_expert_sharding_matches(eight_devices):
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_pjit_moe_expert_sharding_matches(eight_devices, family):
     """The automatic (pjit) path also runs MoE with expert-sharded weights:
     XLA's SPMD partitioner handles the dispatch einsums (and their
-    backward) from the NamedShardings alone."""
+    backward) from the NamedShardings alone. llama's SwiGLU experts
+    exercise the w_gate leaf under EP."""
     from pytorch_distributed_tpu.parallel import make_parallel_train_step
 
-    cfg, model, tx, batch, ref_state, ref_m = _ep_reference()
+    cfg, model, tx, batch, ref_state, ref_m = _ep_reference(family=family)
     mcfg = MeshConfig(expert=4, data=2, strategy="no_shard")
     mesh = make_mesh(mcfg)
     state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
