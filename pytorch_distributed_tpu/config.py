@@ -218,6 +218,11 @@ class TrainConfig:
     log_every_n_steps: int = 10
     save_every_n_steps: int | None = None
     checkpoint_dir: str = "checkpoints"
+    # Optional JSONL metrics sink: every logged window (step/loss/lr/
+    # elapsed) is appended as one JSON object — machine-readable run
+    # history beyond the reference's stdout prints (process 0 only under
+    # the distributed trainer).
+    metrics_path: str | None = None
 
     def grad_accum_steps(self, data_parallel_size: int = 1) -> int:
         """Micro-batches per optimizer step. Single-device rule

@@ -118,6 +118,9 @@ class DistributedTrainer(Trainer):
             )
         return state
 
+    def _is_metrics_writer(self) -> bool:
+        return is_process_zero()
+
     # -- data placement ---------------------------------------------------
     def _put_batch_impl(self, batch: dict) -> dict:
         """Host [A, B_local, T] -> global sharded device batch.

@@ -349,14 +349,14 @@ class Trainer:
                     f"step {new_step}/{num_steps} | loss {avg_loss:.4f} | "
                     f"lr {lr:.2e} | elapsed {elapsed:.1f}s"
                 )
-                history.append(
-                    {
-                        "step": new_step,
-                        "loss": avg_loss,
-                        "lr": lr,
-                        "elapsed_s": elapsed,
-                    }
-                )
+                entry = {
+                    "step": new_step,
+                    "loss": avg_loss,
+                    "lr": lr,
+                    "elapsed_s": elapsed,
+                }
+                history.append(entry)
+                self._write_metrics(entry)
                 window_losses = []
 
             if (
@@ -366,6 +366,23 @@ class Trainer:
                 self.save_checkpoint(state)
 
         return state, history
+
+    def _write_metrics(self, entry: dict) -> None:
+        """Append one JSON line to cfg.metrics_path (if set). Gated to
+        process 0 by the DistributedTrainer's log gating convention —
+        only where _log would print."""
+        path = self.train_cfg.metrics_path
+        if not path or not self._is_metrics_writer():
+            return
+        import json
+
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        with p.open("a") as f:
+            f.write(json.dumps(entry) + "\n")
+
+    def _is_metrics_writer(self) -> bool:
+        return True  # DistributedTrainer overrides with process-0 gating
 
     # -- evaluation -------------------------------------------------------
     def evaluate(

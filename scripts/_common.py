@@ -49,6 +49,8 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--save-every", type=int, default=None)
     p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--metrics-out", default=None,
+                   help="append logged metrics as JSON lines to this file")
     p.add_argument("--resume", action="store_true",
                    help="resume from latest checkpoint (capability the "
                         "reference has at trainer level but never wires up)")
@@ -109,6 +111,7 @@ def build_train_cfg(args, *, data_parallel_size: int = 1):
         log_every_n_steps=args.log_every,
         save_every_n_steps=args.save_every,
         checkpoint_dir=args.checkpoint_dir,
+        metrics_path=args.metrics_out,
     )
     cfg.grad_accum_steps(data_parallel_size)  # validate divisibility early
     return cfg

@@ -219,3 +219,18 @@ def test_evaluate_mean_loss(tiny_config):
 
     with pytest.raises(ValueError, match="empty"):
         trainer.evaluate(state, [])
+
+
+def test_metrics_jsonl(tiny_config, loader, tmp_path):
+    import json
+
+    path = tmp_path / "m" / "metrics.jsonl"
+    trainer, _ = _trainer(
+        tiny_config, num_steps=8, metrics_path=str(path)
+    )
+    trainer.train(loader)
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [e["step"] for e in lines] == [4, 8]
+    assert all(
+        set(e) == {"step", "loss", "lr", "elapsed_s"} for e in lines
+    )
