@@ -223,6 +223,12 @@ class TrainConfig:
     # history beyond the reference's stdout prints (process 0 only under
     # the distributed trainer).
     metrics_path: str | None = None
+    # Graceful preemption (TPU pods get reclaimed): on SIGTERM/SIGINT the
+    # train loop finishes the in-flight step, writes a checkpoint (with
+    # the data-stream position), and returns — so --resume continues the
+    # run exactly. Opt-in; recovery story beyond the reference's plain
+    # checkpoint cadence (SURVEY.md §5.3).
+    save_on_preemption: bool = False
 
     def grad_accum_steps(self, data_parallel_size: int = 1) -> int:
         """Micro-batches per optimizer step. Single-device rule

@@ -54,7 +54,7 @@ class DistributedTokenShardLoader(TokenShardLoader):
         )
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        self._reset()
+        self._begin_iteration()
         b, t = self.local_batch_size, self.sequence_length
         num_tokens_local = b * t  # reference TODO 2 (:69-70)
         num_tokens_global = self.world_size * num_tokens_local

@@ -69,6 +69,38 @@ class TokenShardLoader:
             self.current_position = 0
         return True
 
+    # -- resumable position (beyond reference: its loader restarts from
+    # shard 0 on every run, so checkpoint-resumed training repeats data) --
+    def state_dict(self) -> dict:
+        """Stream position for checkpointing: next-shard index + offset."""
+        return {
+            "shard_idx": int(self.current_shard_idx),
+            "position": int(self.current_position),
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        """Restore a position captured by ``state_dict``; takes effect at
+        the next ``__iter__`` (instead of rewinding to shard 0)."""
+        self._pending_state = (int(sd["shard_idx"]), int(sd["position"]))
+
+    def _begin_iteration(self) -> None:
+        pending = getattr(self, "_pending_state", None)
+        self._reset()
+        if pending is not None:
+            self._pending_state = None
+            idx, pos = pending
+            if idx > 0:
+                if idx > len(self.files):
+                    raise ValueError(
+                        f"loader state shard_idx {idx} exceeds "
+                        f"{len(self.files)} shards"
+                    )
+                self.current_tokens = bin_format.read_tokens(
+                    self.files[idx - 1], mmap=self._mmap
+                )
+                self.current_shard_idx = idx
+                self.current_position = pos
+
     # -- iteration --------------------------------------------------------
     def _next_batch(self) -> tuple[np.ndarray, np.ndarray] | None:
         b, t = self.batch_size, self.sequence_length
@@ -85,7 +117,7 @@ class TokenShardLoader:
         return inputs, targets
 
     def __iter__(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
-        self._reset()
+        self._begin_iteration()
         while True:
             batch = self._next_batch()
             if batch is None:

@@ -138,14 +138,14 @@ class DistributedTrainer(Trainer):
         }
 
     # -- checkpointing ------------------------------------------------------
-    def save_checkpoint(self, state: TrainState) -> str:
+    def save_checkpoint(self, state: TrainState, *, loader=None) -> str:
         # NOT process-0-gated: every process must call — sharded (orbax)
         # saves are collective (each process writes its own shards; gating
         # would deadlock process 0 inside the commit barrier), and the npz
         # path does its own process-0 write gating internally. This is where
         # the reference's rank-0 torch.save (distributed_trainer.py:214-221)
         # is structurally wrong for sharded state, per SURVEY.md §5.4.
-        return super().save_checkpoint(state)
+        return super().save_checkpoint(state, loader=loader)
 
     def train(self, dataloader, *, state=None, profiler=None, num_steps=None):
         if state is None:

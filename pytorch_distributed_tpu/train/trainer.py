@@ -256,22 +256,36 @@ class Trainer:
     def checkpoint_path(self, step: int) -> Path:
         return Path(self.train_cfg.checkpoint_dir) / f"checkpoint_step_{step}"
 
-    def save_checkpoint(self, state: TrainState) -> str:
+    def save_checkpoint(
+        self, state: TrainState, *, loader: Any | None = None
+    ) -> str:
         step = int(jax.device_get(state.step))
+        metadata: dict = {"step": step}
+        if loader is not None and hasattr(loader, "state_dict"):
+            # Data-stream position rides the checkpoint so resumed runs
+            # continue the token stream instead of repeating it (the
+            # reference's loader always restarts at shard 0).
+            metadata["loader_state"] = loader.state_dict()
         return ckpt_lib.save_checkpoint(
             self.checkpoint_path(step),
             state,
-            metadata={"step": step},
+            metadata=metadata,
         )
 
     def load_checkpoint(self, path: str | Path, state: TrainState) -> TrainState:
         return ckpt_lib.load_checkpoint(path, state)
 
-    def resume_latest(self, state: TrainState) -> TrainState:
+    def resume_latest(
+        self, state: TrainState, *, loader: Any | None = None
+    ) -> TrainState:
         latest = ckpt_lib.latest_checkpoint(self.train_cfg.checkpoint_dir)
         if latest is None:
             return state
         self._log(f"resuming from {latest}")
+        if loader is not None and hasattr(loader, "load_state_dict"):
+            meta = ckpt_lib.read_metadata(latest)
+            if "loader_state" in meta:
+                loader.load_state_dict(meta["loader_state"])
         return self.load_checkpoint(latest, state)
 
     # -- data grouping ----------------------------------------------------
@@ -318,52 +332,107 @@ class Trainer:
         t0 = time.perf_counter()
         step = start_step
 
-        for batch in self._grouped_batches(dataloader):
-            if step >= num_steps:
-                break
-            dkey = step_key(self._dropout_root, step)
-            ctx = (
-                profiler.step_context(step)
-                if profiler is not None and hasattr(profiler, "step_context")
-                else contextlib.nullcontext()
+        preempted = {"flag": False}
+        restore_handlers: list = []
+        if cfg.save_on_preemption:
+            import signal
+
+            def _on_signal(signum, frame):
+                preempted["flag"] = True
+
+            try:
+                for sig in (signal.SIGTERM, signal.SIGINT):
+                    restore_handlers.append(
+                        (sig, signal.signal(sig, _on_signal))
+                    )
+            except ValueError:
+                restore_handlers = []  # not the main thread: no handlers
+
+        def stop_requested() -> bool:
+            # Multi-host: the signal lands on individual processes at
+            # different step boundaries; all processes must agree on ONE
+            # stop step or the collective checkpoint save deadlocks. The
+            # allgather runs at the same loop point on every process, so
+            # OR-ing the flags yields a common decision.
+            if not cfg.save_on_preemption:
+                return False
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                flags = multihost_utils.process_allgather(
+                    np.asarray(preempted["flag"])
+                )
+                return bool(np.any(flags))
+            return preempted["flag"]
+
+        # Explicit iterator: the stop check must happen BEFORE fetching the
+        # next batch group, or the saved loader position skips data the
+        # resumed run never trains on.
+        groups = self._grouped_batches(dataloader)
+        try:
+            while step < num_steps:
+              if stop_requested():
+                  break  # checkpoint happens once, after the loop
+              batch = next(groups, None)
+              if batch is None:
+                  break
+              dkey = step_key(self._dropout_root, step)
+              ctx = (
+                  profiler.step_context(step)
+                  if profiler is not None and hasattr(profiler, "step_context")
+                  else contextlib.nullcontext()
+              )
+              with ctx:
+                  state, metrics = self.train_step(
+                      state, self._put_batch(batch), dkey
+                  )
+
+              window_losses.append(metrics["loss"])
+              step = new_step = step + 1
+
+              if profiler is not None:
+                  profiler.step()
+
+              if new_step % cfg.log_every_n_steps == 0 or new_step == num_steps:
+                  losses = [
+                      float(x) for x in jax.device_get(window_losses)
+                  ]  # single sync point for the whole window
+                  elapsed = time.perf_counter() - t0
+                  avg_loss = sum(losses) / len(losses)
+                  lr = lr_at_step(cfg, new_step)
+                  self._log(
+                      f"step {new_step}/{num_steps} | loss {avg_loss:.4f} | "
+                      f"lr {lr:.2e} | elapsed {elapsed:.1f}s"
+                  )
+                  entry = {
+                      "step": new_step,
+                      "loss": avg_loss,
+                      "lr": lr,
+                      "elapsed_s": elapsed,
+                  }
+                  history.append(entry)
+                  self._write_metrics(entry)
+                  window_losses = []
+
+              if (
+                  cfg.save_every_n_steps
+                  and new_step % cfg.save_every_n_steps == 0
+              ):
+                  self.save_checkpoint(state, loader=dataloader)
+        finally:
+            if restore_handlers:
+                import signal
+
+                for sig, prev in restore_handlers:
+                    signal.signal(sig, prev)
+        # NOT short-circuited on the local flag: every process must run the
+        # same number of stop_requested() collectives, and must join the
+        # collective save when ANY process was signalled.
+        if cfg.save_on_preemption and stop_requested():
+            self._log(
+                f"preemption signal received: checkpointing at step {step}"
             )
-            with ctx:
-                state, metrics = self.train_step(
-                    state, self._put_batch(batch), dkey
-                )
-
-            window_losses.append(metrics["loss"])
-            step = new_step = step + 1
-
-            if profiler is not None:
-                profiler.step()
-
-            if new_step % cfg.log_every_n_steps == 0 or new_step == num_steps:
-                losses = [
-                    float(x) for x in jax.device_get(window_losses)
-                ]  # single sync point for the whole window
-                elapsed = time.perf_counter() - t0
-                avg_loss = sum(losses) / len(losses)
-                lr = lr_at_step(cfg, new_step)
-                self._log(
-                    f"step {new_step}/{num_steps} | loss {avg_loss:.4f} | "
-                    f"lr {lr:.2e} | elapsed {elapsed:.1f}s"
-                )
-                entry = {
-                    "step": new_step,
-                    "loss": avg_loss,
-                    "lr": lr,
-                    "elapsed_s": elapsed,
-                }
-                history.append(entry)
-                self._write_metrics(entry)
-                window_losses = []
-
-            if (
-                cfg.save_every_n_steps
-                and new_step % cfg.save_every_n_steps == 0
-            ):
-                self.save_checkpoint(state)
+            self.save_checkpoint(state, loader=dataloader)
 
         return state, history
 

@@ -51,6 +51,10 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
     p.add_argument("--checkpoint-dir", default="checkpoints")
     p.add_argument("--metrics-out", default=None,
                    help="append logged metrics as JSON lines to this file")
+    p.add_argument("--save-on-preemption", action="store_true",
+                   help="on SIGTERM/SIGINT, finish the in-flight step, "
+                        "write a resumable checkpoint (incl. data-stream "
+                        "position), and exit cleanly")
     p.add_argument("--resume", action="store_true",
                    help="resume from latest checkpoint (capability the "
                         "reference has at trainer level but never wires up)")
@@ -112,6 +116,7 @@ def build_train_cfg(args, *, data_parallel_size: int = 1):
         save_every_n_steps=args.save_every,
         checkpoint_dir=args.checkpoint_dir,
         metrics_path=args.metrics_out,
+        save_on_preemption=args.save_on_preemption,
     )
     cfg.grad_accum_steps(data_parallel_size)  # validate divisibility early
     return cfg

@@ -61,7 +61,7 @@ def main() -> int:
     trainer = Trainer(model, model_cfg, train_cfg)
     state = trainer.init_state()
     if args.resume:
-        state = trainer.resume_latest(state)
+        state = trainer.resume_latest(state, loader=loader)
 
     profiler = make_profiler(args, "outputs/traces/baseline")
     try:

@@ -137,9 +137,14 @@ def main() -> int:
     trainer = DistributedTrainer(
         model, model_cfg, train_cfg, mesh, mesh_cfg, path=args.path
     )
+    state = trainer.init_state()
+    if args.resume:
+        state = trainer.resume_latest(state, loader=loader)
     profiler = make_profiler(args, "outputs/traces/parallel")
     try:
-        state, history = trainer.train(loader, profiler=profiler)
+        state, history = trainer.train(
+            loader, state=state, profiler=profiler
+        )
     finally:
         if profiler is not None:
             profiler.close()
