@@ -75,9 +75,13 @@ class ModelConfig:
 
     # Attention implementation: "naive" (materialises the T×T score matrix like
     # reference my_gpt2.py:60-77) or "flash" (blockwise online-softmax /
-    # Pallas). Sequence-parallel ring attention is a parallelism-layer
-    # concern (parallel/), not a per-config switch.
+    # Pallas). Whether the sequence IS sharded is a parallelism-layer
+    # concern (parallel/); seq_impl picks the context-parallel technique
+    # when it is: "ring" (ppermute KV ring, works for any head count) or
+    # "ulysses" (head/sequence all-to-all, needs seq | n_head and
+    # seq | kv_heads).
     attention_impl: str = "naive"
+    seq_impl: str = "ring"
 
     # Mixture-of-Experts (ops/moe.py): 0 = dense MLP (reference behavior);
     # >0 replaces each block's MLP with n_experts expert MLPs and a top-1
@@ -101,6 +105,11 @@ class ModelConfig:
             raise ValueError(
                 f"unknown attention_impl: {self.attention_impl!r} "
                 "(implemented: naive, flash)"
+            )
+        if self.seq_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown seq_impl: {self.seq_impl!r} "
+                "(implemented: ring, ulysses)"
             )
         if self.n_experts and self.family not in ("gpt2", "llama"):
             raise ValueError(

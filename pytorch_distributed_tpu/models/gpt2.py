@@ -176,6 +176,7 @@ def _block(
         dropout_key=k_attn,
         deterministic=deterministic,
         seq_axis=seq_axis,
+        seq_impl=cfg.seq_impl,
     ).reshape(b, t, -1)  # [B, T, E] (E/tp local columns under explicit TP)
     if not _flash_kernel_active(cfg, t, seq_axis, deterministic):
         # On the Pallas path the kernel's o output is already saved by the

@@ -105,7 +105,7 @@ def _block(
     v = v.reshape(b, t, -1, d)
     a = multi_head_attention(
         q, k, v, impl=cfg.attention_impl, causal=True, deterministic=True,
-        seq_axis=seq_axis,
+        seq_axis=seq_axis, seq_impl=cfg.seq_impl,
     ).reshape(b, t, -1)
     if not _flash_kernel_active(cfg, t, seq_axis):
         # Pallas path: the kernel's o is already policy-saved (see gpt2.py).

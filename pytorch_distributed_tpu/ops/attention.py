@@ -87,21 +87,36 @@ def multi_head_attention(
     dropout_key: jax.Array | None = None,
     deterministic: bool = True,
     seq_axis: str | None = None,
+    seq_impl: str = "ring",
 ) -> jax.Array:
     """Dispatch over attention implementations. Inputs [B, T, H(kv), D].
 
     ``seq_axis``: name of a shard_map mesh axis the sequence dim is sharded
-    over — selects ring attention (sequence/context parallelism) regardless
-    of ``impl``. Attention dropout is unsupported under sequence sharding
-    (the reference has no sequence parallelism at all, SURVEY.md §5.7).
+    over — selects sequence/context parallelism regardless of ``impl``;
+    ``seq_impl`` picks the technique: "ring" (KV blocks stream around a
+    ppermute ring, online-softmax merge) or "ulysses" (head/sequence
+    all-to-all re-shard, full local attention — needs the axis to divide
+    the head counts). Attention dropout is unsupported under sequence
+    sharding (the reference has no sequence parallelism at all,
+    SURVEY.md §5.7).
     """
     if seq_axis is not None:
-        from pytorch_distributed_tpu.ops.ring_attention import ring_attention
-
         if not deterministic and dropout_rate > 0.0:
             raise NotImplementedError(
                 "attention dropout is not supported with sequence parallelism"
             )
+        if seq_impl == "ulysses":
+            from pytorch_distributed_tpu.ops.ulysses import ulysses_attention
+
+            return ulysses_attention(
+                q, k, v, axis_name=seq_axis, causal=causal, impl=impl
+            )
+        if seq_impl != "ring":
+            raise KeyError(
+                f"unknown seq_impl {seq_impl!r}; known: ring, ulysses"
+            )
+        from pytorch_distributed_tpu.ops.ring_attention import ring_attention
+
         return ring_attention(q, k, v, axis_name=seq_axis, causal=causal)
     if impl == "naive":
         return naive_attention(

@@ -1,0 +1,81 @@
+"""Ulysses (DeepSpeed-style) all-to-all sequence parallelism.
+
+The second canonical long-context technique next to ring attention
+(ops/ring_attention.py). Where ring keeps the sequence sharded and streams
+KV blocks around the ring (n - 1 ppermute hops, online-softmax merging),
+Ulysses re-shards ONCE per attention call:
+
+    [B, T/n, H, D]  --all_to_all-->  [B, T, H/n, D]
+    full-sequence attention on the local head group (any backend)
+    [B, T, H/n, D]  --all_to_all-->  [B, T/n, H, D]
+
+Two all-to-alls (plus two for K/V) move the same bytes a ring moves in
+total, but as one balanced shuffle instead of n-1 dependent hops — the
+standard trade: Ulysses needs H divisible by the mesh axis and its
+collective pattern loves full-bisection fabrics; ring only needs T
+divisible and tolerates skinny rings. Inside shard_map the local attention
+sees the FULL sequence, so the math (causal mask, softmax) is exactly the
+single-device computation — no online merging, and AD differentiates the
+all-to-alls natively (their transpose is the reverse all-to-all).
+
+GQA: KV heads are scattered the same way, so n must divide the KV head
+count too (repeat_kv first if it does not — the caller's choice).
+"""
+
+from __future__ import annotations
+
+import jax
+
+def _heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, T_local, H, D] -> [B, T_global, H_local, D]."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+
+
+def _seq_to_heads(x: jax.Array, axis_name: str) -> jax.Array:
+    """[B, T_global, H_local, D] -> [B, T_local, H, D]."""
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, T_local, H, D] (sequence-sharded over axis_name)
+    k: jax.Array,  # [B, T_local, Hkv, D]
+    v: jax.Array,  # [B, T_local, Hkv, D]
+    *,
+    axis_name: str,
+    causal: bool = True,
+    impl: str = "naive",
+) -> jax.Array:
+    """Sequence-parallel attention via head/sequence all-to-all re-sharding.
+
+    Must run inside shard_map with the T dim sharded over ``axis_name``.
+    Returns [B, T_local, H, D] with the same sharding as ``q``. ``impl``
+    picks the LOCAL full-sequence backend: "flash" (blockwise/Pallas,
+    O(T) memory — what long context needs) or "naive" (O(T^2) scores).
+    """
+    n = jax.lax.psum(1, axis_name)
+    h, hkv = q.shape[2], k.shape[2]
+    if h % n or hkv % n:
+        raise ValueError(
+            f"ulysses needs the mesh axis ({n}) to divide both head counts "
+            f"(H={h}, Hkv={hkv}); use ring attention (or repeat KV heads) "
+            "otherwise"
+        )
+    qh = _heads_to_seq(q, axis_name)  # [B, T, H/n, D]
+    kh = _heads_to_seq(k, axis_name)
+    vh = _heads_to_seq(v, axis_name)
+    # Full-sequence attention on the local head group — exactly the
+    # single-device math (GQA group structure is preserved: H/n query
+    # heads over Hkv/n KV heads keeps the same group size).
+    if impl == "flash":
+        from pytorch_distributed_tpu.ops.pallas_flash import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal)
+    else:
+        from pytorch_distributed_tpu.ops.attention import naive_attention
+
+        out = naive_attention(qh, kh, vh, causal=causal)
+    return _seq_to_heads(out, axis_name)

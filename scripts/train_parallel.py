@@ -8,6 +8,7 @@ set from the CLI:
   --mesh data=2,fsdp=2,tensor=2      pjit/NamedSharding (auto) or explicit
                                      shard_map collectives (--path explicit)
   --mesh fsdp=2,seq=4 --path explicit   ring-attention context parallelism
+                                        (--seq-impl ulysses: all-to-all CP)
   --mesh pipe=4,data=2 --path pipeline  GPipe pipeline schedule
   --mesh expert=4,data=2 --n-experts 4  MoE expert parallelism
 
@@ -72,6 +73,13 @@ def main() -> int:
     )
     p.add_argument("--n-experts", type=int, default=0)
     p.add_argument(
+        "--seq-impl", default="ring", choices=["ring", "ulysses"],
+        help="context-parallel technique when the seq axis > 1 on the "
+             "EXPLICIT path (--path explicit): ring (ppermute KV ring) or "
+             "ulysses (head/seq all-to-all; needs the axis to divide the "
+             "head counts)",
+    )
+    p.add_argument(
         "--no-dropout", action="store_true",
         help="zero all dropout (required for seq/pipeline paths)",
     )
@@ -111,6 +119,14 @@ def main() -> int:
     model_cfg = build_model_cfg(args)
     if args.n_experts:
         model_cfg = model_cfg.replace(n_experts=args.n_experts)
+    if args.seq_impl != "ring":
+        if args.path != "explicit" or axes.get("seq", 1) <= 1:
+            raise SystemExit(
+                "--seq-impl ulysses requires --path explicit and a seq>1 "
+                "mesh axis (the auto path shards T via NamedSharding and "
+                "never calls the CP kernels)"
+            )
+        model_cfg = model_cfg.replace(seq_impl=args.seq_impl)
     if args.no_dropout or mesh_cfg.seq > 1 or args.path == "pipeline":
         model_cfg = model_cfg.replace(
             embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0
