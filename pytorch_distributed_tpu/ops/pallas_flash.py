@@ -222,9 +222,18 @@ def blockwise_attention(
             )
             return (acc_new, m_new, l_new), None
 
-        acc0 = jnp.zeros((b, h, block_q, d), jnp.float32)
-        m0 = jnp.full((b, h, block_q), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, h, block_q), jnp.float32)
+        # Inside shard_map (e.g. as the Ulysses local backend) the scan
+        # carry must vary on the same mesh axes as the activations.
+        from pytorch_distributed_tpu.ops.tp import pvary_missing
+
+        vma = tuple(getattr(jax.typeof(q_blk), "vma", frozenset()))
+        acc0 = pvary_missing(
+            jnp.zeros((b, h, block_q, d), jnp.float32), vma
+        )
+        m0 = pvary_missing(
+            jnp.full((b, h, block_q), NEG_INF, jnp.float32), vma
+        )
+        l0 = pvary_missing(jnp.zeros((b, h, block_q), jnp.float32), vma)
         ks = jnp.arange(nk)
         (acc, m, l), _ = jax.lax.scan(
             kv_step,
