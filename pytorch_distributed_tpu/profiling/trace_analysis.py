@@ -26,16 +26,22 @@ from collections import defaultdict
 from pathlib import Path
 
 _COMM_MARKERS = (
+    # Hyphen-normalised (classify_op folds "_" -> "-"): catches XLA's
+    # all-gather / all_gather / allgather spellings plus async -start/-done
+    # forms, on both HLO instruction names and profiler trace rows. Pinned
+    # against the compiler's actual emitted names by
+    # tests/test_hlo_collectives.py.
     "all-reduce", "allreduce", "all-gather", "allgather", "reduce-scatter",
-    "reduce_scatter", "collective-permute", "collective_permute",
-    "all-to-all", "alltoall", "psum", "send", "recv", "collective",
+    "reducescatter", "collective-permute", "all-to-all", "alltoall",
+    "ragged-all-to-all", "psum", "pmean", "ppermute", "send", "recv",
+    "collective",
 )
 _MEMCPY_MARKERS = ("copy-start", "copy-done", "copy.", "memcpy", "transpose-copy")
 _INFRA_MARKERS = ("infeed", "outfeed", "host-callback")
 
 
 def classify_op(name: str) -> str:
-    n = name.lower()
+    n = name.lower().replace("_", "-")
     if any(m in n for m in _COMM_MARKERS):
         return "communication"
     if any(m in n for m in _MEMCPY_MARKERS):
