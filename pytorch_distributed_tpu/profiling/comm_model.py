@@ -1,0 +1,152 @@
+"""Analytic communication-overhead model for multi-chip projections.
+
+The benchmark rig has ONE real chip (BENCH methodology), so any multi-chip
+number in RESULTS.md is a *projection*, not a measurement. This module makes
+that projection explicit and auditable: given a parameter count, a mesh
+size, and the measured single-chip step time, it computes the per-step
+collective traffic each parallelism strategy implies (the same accounting
+the reference's FSDP docs describe: per-block all_gather in forward,
+re-gather + reduce_scatter in backward, reference train_fsdp.py:49-59) and
+turns it into a projected step-time / MFU *band*.
+
+Why a band, not a number: two genuinely uncertain factors —
+
+- effective per-chip ICI bandwidth a collective sustains (link count,
+  bidirectional rings, protocol efficiency), bracketed by
+  ``ici_eff_low/high``;
+- compute/communication overlap achieved by XLA's latency-hiding scheduler,
+  bracketed by no-overlap (t_comp + t_comm) and full-overlap
+  (max(t_comp, t_comm)).
+
+Chip constants are public-spec numbers, recorded here as assumptions, not
+measurements (v5e: 197 TFLOP/s bf16 peak; 1,600 Gbps aggregate ICI per
+chip over 4 links in a 2D torus -> ~50-100 GB/s per-chip effective
+collective throughput; the band below is deliberately conservative).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    peak_bf16_flops: float
+    # Effective per-chip ICI bytes/s a ring collective sustains, bracketed.
+    ici_eff_low: float
+    ici_eff_high: float
+    hbm_bytes: float
+
+
+V5E = ChipSpec(
+    name="v5e",
+    peak_bf16_flops=197e12,
+    ici_eff_low=45e9,
+    ici_eff_high=90e9,
+    hbm_bytes=16e9,
+)
+
+
+def fsdp_comm_bytes_per_step(
+    n_params: int,
+    n_chips: int,
+    *,
+    param_bytes: int = 2,
+    grad_bytes: int | None = None,
+) -> dict:
+    """Per-chip collective traffic of one ZeRO-3 (full_shard) step.
+
+    Ring-collective accounting (each of the three collectives moves the
+    full tensor minus this chip's shard through each chip's links):
+
+    - forward:  all_gather(params)            -> P * (N-1)/N bytes
+    - backward: re-gather under remat         -> P * (N-1)/N bytes
+    - backward: reduce_scatter(grads)         -> G * (N-1)/N bytes
+    """
+    if n_chips < 2:
+        return {"all_gather": 0.0, "reduce_scatter": 0.0, "total": 0.0}
+    grad_bytes = param_bytes if grad_bytes is None else grad_bytes
+    frac = (n_chips - 1) / n_chips
+    ag = 2.0 * n_params * param_bytes * frac
+    rs = float(n_params) * grad_bytes * frac
+    return {"all_gather": ag, "reduce_scatter": rs, "total": ag + rs}
+
+
+def ddp_comm_bytes_per_step(
+    n_params: int, n_chips: int, *, grad_bytes: int = 4
+) -> dict:
+    """Per-chip traffic of one DDP step: one ring all-reduce of the grads
+    (= reduce_scatter + all_gather, 2 * G * (N-1)/N bytes)."""
+    if n_chips < 2:
+        return {"all_reduce": 0.0, "total": 0.0}
+    frac = (n_chips - 1) / n_chips
+    ar = 2.0 * n_params * grad_bytes * frac
+    return {"all_reduce": ar, "total": ar}
+
+
+def project_step(
+    *,
+    comm_bytes: float,
+    compute_ms: float,
+    chip: ChipSpec = V5E,
+) -> dict:
+    """Projected per-step time band [best, worst] in ms.
+
+    best  = full overlap at the optimistic bandwidth: max(comp, comm_fast)
+    worst = zero overlap at the conservative bandwidth: comp + comm_slow
+    """
+    comm_fast_ms = comm_bytes / chip.ici_eff_high * 1e3
+    comm_slow_ms = comm_bytes / chip.ici_eff_low * 1e3
+    return {
+        "comm_ms_band": (comm_fast_ms, comm_slow_ms),
+        "step_ms_band": (
+            max(compute_ms, comm_fast_ms),
+            compute_ms + comm_slow_ms,
+        ),
+    }
+
+
+def project_fsdp_mfu(
+    *,
+    n_params: int,
+    n_chips: int,
+    measured_ms_per_step: float,
+    measured_mfu_pct: float,
+    param_bytes: int = 2,
+    grad_bytes: int | None = None,
+    chip: ChipSpec = V5E,
+) -> dict:
+    """Project a measured single-chip (no-communication) step onto an
+    N-chip FSDP mesh with the SAME per-chip batch (weak scaling: per-chip
+    compute time unchanged, collective traffic added on top).
+
+    Returns the projected MFU band: measured_mfu * compute / step_time for
+    the [best, worst] step-time band — the honest version of a "fsdp8 MFU"
+    table entry (VERDICT r2 weak #1).
+    """
+    traffic = fsdp_comm_bytes_per_step(
+        n_params, n_chips, param_bytes=param_bytes, grad_bytes=grad_bytes
+    )
+    proj = project_step(
+        comm_bytes=traffic["total"], compute_ms=measured_ms_per_step,
+        chip=chip,
+    )
+    best_ms, worst_ms = proj["step_ms_band"]
+    return {
+        "chip": chip.name,
+        "n_chips": n_chips,
+        "comm_bytes_per_step": traffic,
+        "comm_ms_band": proj["comm_ms_band"],
+        "step_ms_band": (best_ms, worst_ms),
+        "mfu_pct_band": (
+            measured_mfu_pct * measured_ms_per_step / worst_ms,
+            measured_mfu_pct * measured_ms_per_step / best_ms,
+        ),
+        "assumptions": (
+            f"{chip.name} public specs; ici_eff "
+            f"{chip.ici_eff_low/1e9:.0f}-{chip.ici_eff_high/1e9:.0f} GB/s "
+            "per chip; overlap bracketed none..full; weak scaling (same "
+            "per-chip batch); single-chip measured compute time"
+        ),
+    }

@@ -144,6 +144,17 @@ def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
     tok_s = statistics.median(tps)
     flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * T
     mfu = tok_s * flops_per_token / V5E_PEAK_BF16
+    notes = []
+    if row.get("mesh"):
+        # The FSDP-labeled configs are MEASURED on one chip with no mesh
+        # and no collectives — an upper bound on the multi-chip number,
+        # never the config's number (VERDICT r2 weak #1). Said in the row.
+        notes.append("single-chip proxy — NO FSDP communication")
+    if row["param_dtype"] == "bfloat16":
+        notes.append(
+            "bf16 optimizer state (f32 state for ~1B params exceeds one "
+            "chip's HBM)"
+        )
     return dict(
         kind="measured",
         platform=jax.devices()[0].platform,
@@ -154,10 +165,7 @@ def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
         mfu_pct=round(mfu * 100, 1),
         window_spread=round(max(tps) / min(tps), 3),
         final_loss=round(loss, 3),
-        note=(
-            "bf16 optimizer state (f32 state for ~1B params exceeds one "
-            "chip's HBM)" if row["param_dtype"] == "bfloat16" else ""
-        ),
+        note="; ".join(notes),
     )
 
 
@@ -240,18 +248,57 @@ def run_virtual_subprocess(row_id: int) -> dict:
     return json.loads(proc.stdout.strip().splitlines()[-1])
 
 
+def _projection_for(rid: str, res: dict) -> dict | None:
+    """Analytic v5e-16 FSDP projection for a measured single-chip proxy row
+    (profiling/comm_model.py; unit-tested in tests/test_comm_model.py)."""
+    row = ROWS[int(rid)]
+    if res.get("kind") != "measured" or not row.get("mesh"):
+        return None
+    sys.path.insert(0, str(REPO))
+    from pytorch_distributed_tpu.profiling.comm_model import project_fsdp_mfu
+
+    param_bytes = 2 if row["param_dtype"] == "bfloat16" else 4
+    return project_fsdp_mfu(
+        n_params=res["n_params"],
+        n_chips=16,
+        measured_ms_per_step=res["ms_per_step"],
+        measured_mfu_pct=res["mfu_pct"],
+        param_bytes=param_bytes,
+    )
+
+
 def write_artifacts(results: dict) -> None:
     outdir = REPO / "benchmarks"
     outdir.mkdir(exist_ok=True)
+    for rid, res in list(results["rows"].items()):
+        if res.get("kind") == "measured" and ROWS[int(rid)].get("mesh"):
+            # Normalise rows produced by older suite versions too (--regen).
+            if "single-chip proxy" not in (res.get("note") or ""):
+                res["note"] = "; ".join(
+                    x for x in
+                    ["single-chip proxy — NO FSDP communication",
+                     res.get("note") or ""]
+                    if x
+                )
+        proj = _projection_for(rid, res)
+        if proj is not None:
+            res["v5e16_projection"] = proj
     (outdir / "results.json").write_text(json.dumps(results, indent=1))
 
     lines = [
         "# Benchmark results (BASELINE.md configs 1-5)",
         "",
-        f"Generated by `scripts/bench_suite.py`. "
-        f"Measured rows: real accelerator, median of timed windows "
-        f"(bench.py methodology). Correctness-only rows: 8-virtual-device "
-        f"CPU mesh at reduced dims — parallelism wiring only.",
+        "Generated by `scripts/bench_suite.py`. Three kinds of rows:",
+        "",
+        "- **measured** — real accelerator, median of timed windows "
+        "(bench.py methodology). The rig has ONE chip: rows whose config "
+        "names a multi-chip mesh are **single-chip proxies with NO "
+        "communication** — an upper bound, not the config's number.",
+        "- **projected** — the single-chip measurement plus the analytic "
+        "collective-traffic model (`profiling/comm_model.py`, unit-tested): "
+        "an MFU *band* bracketing bandwidth and overlap assumptions.",
+        "- **correctness-only** — 8-virtual-device CPU mesh at reduced "
+        "dims; validates the parallelism wiring, no throughput claim.",
         "",
         "| # | Config | Parallelism | tok/s/chip | ms/step | MFU | Status |",
         "|---|--------|-------------|-----------:|--------:|----:|--------|",
@@ -259,12 +306,25 @@ def write_artifacts(results: dict) -> None:
     for rid, res in sorted(results["rows"].items(), key=lambda kv: int(kv[0])):
         row = ROWS[int(rid)]
         if res.get("kind") == "measured":
+            par = (
+                "none (single chip)" if row.get("mesh") else row["parallelism"]
+            )
             lines.append(
-                f"| {rid} | {row['name']} | {row['parallelism']} | "
+                f"| {rid} | {row['name']} | {par} | "
                 f"{res['tokens_per_sec_per_chip']:,.0f} | "
                 f"{res['ms_per_step']} | {res['mfu_pct']}% | measured "
                 f"({res.get('note') or 'real chip'}) |"
             )
+            proj = res.get("v5e16_projection")
+            if proj is not None:
+                lo, hi = proj["mfu_pct_band"]
+                s_lo, s_hi = proj["step_ms_band"]
+                lines.append(
+                    f"| {rid}p | {row['name']} -> v5e-16 fsdp16 | fsdp16 | "
+                    f"n/a | {s_lo:.0f}-{s_hi:.0f} | "
+                    f"{lo:.1f}-{hi:.1f}% | PROJECTED (analytic comm model; "
+                    f"not a measurement) |"
+                )
         else:
             status = (
                 "correctness-only (virtual CPU mesh)"
@@ -290,6 +350,11 @@ def write_artifacts(results: dict) -> None:
         "attention, named-saves remat, bf16 logits, no dropout.",
         "- ~1B-param rows use bf16 optimizer state to fit one chip's HBM; "
         "multi-chip f32-state runs are what the mesh configs are for.",
+        "- The BASELINE.md north star (>=40% MFU for 1B FSDP on v5e-16) is "
+        "**projected**, not achieved: the projected bands above come from "
+        "the comm model's assumptions (per-chip ICI 45-90 GB/s effective, "
+        "overlap bracketed none..full, weak scaling), and no multi-chip "
+        "measurement exists on this rig.",
     ]
     (outdir / "RESULTS.md").write_text("\n".join(lines) + "\n")
     print(f"wrote {outdir / 'results.json'} and {outdir / 'RESULTS.md'}")
@@ -304,12 +369,22 @@ def main() -> None:
     # 48 steps the number converges on the device-trace step time.
     ap.add_argument("--window-steps", type=int, default=48)
     ap.add_argument("--no-virtual", action="store_true")
+    ap.add_argument(
+        "--regen", action="store_true",
+        help="rewrite RESULTS.md (+ projections) from the committed "
+        "results.json without re-measuring — no accelerator needed",
+    )
     ap.add_argument("--virtual-row", type=int, default=None,
                     help=argparse.SUPPRESS)  # child-process entry
     args = ap.parse_args()
 
     if args.virtual_row is not None:
         virtual_row_main(args.virtual_row)
+        return
+
+    if args.regen:
+        prior = REPO / "benchmarks" / "results.json"
+        write_artifacts(json.loads(prior.read_text()))
         return
 
     row_ids = [int(r) for r in args.rows.split(",")]
