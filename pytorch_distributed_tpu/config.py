@@ -72,6 +72,10 @@ class ModelConfig:
     # flash kernel's o/l/m, but never the quadratic score matrix — see
     # ops/remat.py).
     remat: str = "dots"
+    # Unroll factor for the scan-over-layers (1 = no unroll). Unrolling
+    # lets XLA fuse/pipeline across layer boundaries (e.g. merge adjacent
+    # activation-save dynamic-update-slices) at the cost of HLO size.
+    scan_unroll: int = 1
 
     # Attention implementation: "naive" (materialises the T×T score matrix like
     # reference my_gpt2.py:60-77) or "flash" (blockwise online-softmax /
@@ -91,6 +95,13 @@ class ModelConfig:
     n_experts: int = 0
     expert_capacity_factor: float = 1.25
     moe_aux_coef: float = 0.01
+    # Router top-k: 1 = Switch (argmax expert, raw-prob gate); k>1 =
+    # GShard-style (k best experts, renormalised gates).
+    moe_top_k: int = 1
+    # Token dispatch: "einsum" (one-hot [A,X,C] tensor — exact-parity
+    # path), "sort" (sort/segment path, O(A·D) memory — the at-scale
+    # form), "auto" picks by dispatch-tensor size (ops/moe.py).
+    moe_dispatch: str = "auto"
 
     def __post_init__(self) -> None:
         if self.n_embd % self.n_head != 0:
@@ -114,6 +125,20 @@ class ModelConfig:
         if self.n_experts and self.family not in ("gpt2", "llama"):
             raise ValueError(
                 "MoE (n_experts > 0) requires the gpt2 or llama family"
+            )
+        if self.n_experts and not (1 <= self.moe_top_k <= self.n_experts):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} out of range for "
+                f"n_experts={self.n_experts}"
+            )
+        if self.moe_dispatch not in ("auto", "einsum", "sort"):
+            raise ValueError(
+                f"unknown moe_dispatch: {self.moe_dispatch!r} "
+                "(implemented: auto, einsum, sort)"
+            )
+        if self.scan_unroll < 1:
+            raise ValueError(
+                f"scan_unroll must be >= 1, got {self.scan_unroll}"
             )
 
     @property
@@ -238,6 +263,16 @@ class TrainConfig:
     # run exactly. Opt-in; recovery story beyond the reference's plain
     # checkpoint cadence (SURVEY.md §5.3).
     save_on_preemption: bool = False
+    # Multi-host: how often (in optimizer steps) processes agree on a stop
+    # decision. Each sync is a host-blocking process_allgather; 1 = every
+    # step (tightest preemption response), N amortises the sync cost at
+    # the price of up to N-1 extra steps after the signal. Signals landing
+    # between syncs are deferred to the next sync so every process reaches
+    # the same decision at the same step. Multi-host preemption requires
+    # lockstep loaders (DistributedTokenShardLoader): all processes must
+    # exhaust data at the same iteration or ANY collective — including the
+    # train step itself — deadlocks.
+    preemption_sync_every_n_steps: int = 1
 
     def grad_accum_steps(self, data_parallel_size: int = 1) -> int:
         """Micro-batches per optimizer step. Single-device rule

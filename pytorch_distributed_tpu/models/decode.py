@@ -194,6 +194,12 @@ def generate(
     single-token decode steps against the cache.
     """
     b, tp = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        # Nothing to generate: the prompt IS the output (the write of the
+        # first sampled token below would statically index out of bounds).
+        return prompt.astype(jnp.int32)
     total = tp + max_new_tokens
     max_len = max_len or total
     if temperature > 0.0 and key is None:

@@ -201,6 +201,8 @@ def _block(
             activation=activation(cfg.activation_function),
             capacity_factor=cfg.expert_capacity_factor,
             expert_axis=expert_axis,
+            top_k=cfg.moe_top_k,
+            dispatch_impl=cfg.moe_dispatch,
         )
     else:
         aux = jnp.zeros((), jnp.float32)
@@ -315,7 +317,8 @@ def apply(
         tuple(getattr(jax.typeof(x), "vma", frozenset())),
     )
     (x, aux_total), _ = jax.lax.scan(
-        body, (x, aux0), (params["blocks"], layer_ids)
+        body, (x, aux0), (params["blocks"], layer_ids),
+        unroll=cfg.scan_unroll,
     )
     if return_hidden:
         out = layer_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
@@ -341,12 +344,20 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
     return x.astype(jnp.dtype(cfg.dtype))
 
 
-def run_blocks(blocks: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+def run_blocks(
+    blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None
+) -> jax.Array:
     """Scan a stack of [L_local, ...] block params over x (L_local may be a
     pipeline stage's slice of the full depth). Dense configs only — the
-    pipeline path rejects MoE at build time (aux loss is discarded here)."""
+    pipeline path rejects MoE at build time (aux loss is discarded here).
+
+    ``block_transform`` (e.g. a per-layer fsdp all_gather) runs on each
+    sliced layer INSIDE the rematted body, so backward re-gathers instead
+    of saving gathered params (same contract as ``apply``'s)."""
 
     def body(carry, bp):
+        if block_transform is not None:
+            bp = block_transform(bp)
         h, _aux = _block(carry, bp, cfg, None, True)
         return h, None
 

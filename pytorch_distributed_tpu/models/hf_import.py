@@ -264,11 +264,38 @@ def from_hf_pretrained(model_name: str = "gpt2", cfg: ModelConfig | None = None)
 
     hf_cfg = AutoConfig.from_pretrained(model_name)
     is_llama = hf_cfg.model_type in ("llama", "mistral")
+    # Mistral checkpoints use sliding-window attention, which this model
+    # family does not implement (full causal attention only). Beyond the
+    # window the two attention patterns diverge, so the usable context is
+    # clamped to the window; logits within it match HF exactly.
+    sliding = getattr(hf_cfg, "sliding_window", None)
+    if hf_cfg.model_type == "mistral" and sliding:
+        if cfg is not None and cfg.n_ctx > int(sliding):
+            # An explicit cfg must stay within the window: beyond it the
+            # full-causal logits silently diverge from HF, so refuse
+            # rather than import wrong.
+            raise ValueError(
+                f"cfg.n_ctx={cfg.n_ctx} exceeds {model_name!r}'s sliding "
+                f"window ({sliding}); pass cfg with n_ctx <= {sliding} "
+                "(full-causal attention diverges from HF beyond it)"
+            )
+        import warnings
+
+        warnings.warn(
+            f"{model_name!r} uses sliding-window attention (window="
+            f"{sliding}); importing with full causal attention and "
+            f"n_ctx clamped to the window — sequences longer than "
+            f"{sliding} tokens are rejected rather than silently wrong.",
+            stacklevel=2,
+        )
     if cfg is None:
         if is_llama:
+            n_ctx = hf_cfg.max_position_embeddings
+            if hf_cfg.model_type == "mistral" and sliding:
+                n_ctx = min(n_ctx, int(sliding))
             cfg = model_config("llama3-1b").replace(
                 vocab_size=hf_cfg.vocab_size,
-                n_ctx=hf_cfg.max_position_embeddings,
+                n_ctx=n_ctx,
                 n_embd=hf_cfg.hidden_size,
                 n_layer=hf_cfg.num_hidden_layers,
                 n_head=hf_cfg.num_attention_heads,

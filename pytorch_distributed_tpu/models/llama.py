@@ -125,6 +125,8 @@ def _block(
             activation=jax.nn.silu,
             capacity_factor=cfg.expert_capacity_factor,
             expert_axis=expert_axis,
+            top_k=cfg.moe_top_k,
+            dispatch_impl=cfg.moe_dispatch,
         )
         return x + m, aux
     aux = jnp.zeros((), jnp.float32)
@@ -200,7 +202,9 @@ def apply(
         jnp.zeros((), jnp.float32),
         tuple(getattr(jax.typeof(x), "vma", frozenset())),
     )
-    (x, aux_total), _ = jax.lax.scan(body, (x, aux0), params["blocks"])
+    (x, aux_total), _ = jax.lax.scan(
+        body, (x, aux0), params["blocks"], unroll=cfg.scan_unroll
+    )
     if return_hidden:
         # Final-norm hidden states for the fused head+CE loss (see
         # models/gpt2.py apply docstring).
@@ -222,11 +226,15 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
     return params["wte"][input_ids].astype(jnp.dtype(cfg.dtype))
 
 
-def run_blocks(blocks: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+def run_blocks(
+    blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None
+) -> jax.Array:
     t = x.shape[1]
     cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta)
 
     def body(carry, bp):
+        if block_transform is not None:
+            bp = block_transform(bp)
         h, _aux = _block(carry, bp, cfg, cos, sin)
         return h, None
 

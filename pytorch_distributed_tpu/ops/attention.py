@@ -108,8 +108,15 @@ def multi_head_attention(
         if seq_impl == "ulysses":
             from pytorch_distributed_tpu.ops.ulysses import ulysses_attention
 
+            # Local backend defaults to flash: after the head/sequence
+            # re-shard the local attention sees the FULL sequence, and
+            # naive's [T_global, T_global] score matrix is exactly what
+            # sequence parallelism exists to avoid. "naive" is promoted to
+            # flash (same math up to online-softmax reordering); an
+            # explicit impl="flash" passes through unchanged.
             return ulysses_attention(
-                q, k, v, axis_name=seq_axis, causal=causal, impl=impl
+                q, k, v, axis_name=seq_axis, causal=causal,
+                impl="flash" if impl == "naive" else impl,
             )
         if seq_impl != "ring":
             raise KeyError(

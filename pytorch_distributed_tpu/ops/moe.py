@@ -1,16 +1,37 @@
-"""Mixture-of-Experts MLP with expert parallelism (Switch-style top-1).
+"""Mixture-of-Experts MLP with expert parallelism (top-1 Switch / top-k).
 
 Beyond-reference capability (the reference MLP is dense, my_gpt2.py:80-99):
-the block's MLP is replaced by n_experts expert MLPs and a learned top-1
-router, in the Mesh-TensorFlow/Switch formulation:
+the block's MLP is replaced by n_experts expert MLPs and a learned router.
 
-  router logits [T, X] -> top-1 expert per token; position-in-expert by
-  cumsum; tokens beyond the per-expert capacity C are dropped (their MLP
-  output is zero — the residual stream carries them unchanged).
-  dispatch one-hot [T, X, C] scatters token vectors to [X, C, D] expert
-  batches; experts run as ONE batched matmul pair (MXU-friendly — no
-  ragged shapes, no host control flow); combine weights (the router
-  probability at the kept position) gather outputs back to [T, D].
+Routing:
+- ``top_k=1`` (default): Switch semantics — each token goes to its argmax
+  expert, gated by that expert's router probability.
+- ``top_k>1``: GShard-style — each token goes to its k highest-probability
+  experts; the selected probabilities are renormalised to sum to 1.
+
+Capacity: per-expert token slots C = ceil(T * factor / X); assignments past
+capacity are dropped (their MLP contribution is zero — the residual stream
+carries the token unchanged). Assignment priority is token order, then
+choice rank — identical between both dispatch implementations below.
+
+Two dispatch implementations behind ``dispatch_impl``:
+
+- ``"einsum"`` — the Mesh-TensorFlow/Switch one-hot formulation: a
+  [A, X, C] f32 dispatch tensor (A = T*top_k assignments) drives a pair of
+  einsums. MXU-friendly and exactly differentiable, but the dispatch
+  tensor is O(T·X·C) — the textbook-unscalable form (T=8192, X=64, C=160
+  would be 3.4 GB per layer per microbatch).
+- ``"sort"`` — scalable path: assignments are stably sorted by expert id,
+  position-in-expert comes from a bincount/segment arithmetic, and tokens
+  move through 1-D gathers/scatters into the SAME [X, C, D] expert-batch
+  layout. Memory O(A·D + X·C·D); no [A, X, C] tensor ever exists. XLA
+  sorts/gathers compile to fast TPU kernels, and the expert compute is the
+  same pair of batched matmuls.
+- ``"auto"`` picks einsum while the dispatch tensor stays small (exact
+  parity path at test scale), sort beyond ``_AUTO_EINSUM_LIMIT`` elements.
+
+Equivalence of the two is pinned by tests/test_moe.py (same routing, same
+drops, same outputs within fp tolerance).
 
 Expert parallelism (``expert_axis`` inside shard_map): expert weights are
 sharded over the axis, tokens are sharded over it too (it acts as a data
@@ -26,16 +47,22 @@ C_local tokens to each expert), so a generous capacity_factor reproduces
 the single-device result exactly — pinned by tests/test_moe.py.
 
 Deterministic routing (no jitter noise). The Switch load-balancing
-auxiliary loss is returned alongside the output and both trainer paths add
-``moe_aux_coef * aux`` to the objective; under EP it is computed per
-token-shard and averaged (the standard distributed convention — differs
-from the global-batch product only at O(1e-4) on balanced batches).
+auxiliary loss (computed from FIRST-choice assignment fractions, which for
+top_k=1 is exactly the Switch term) is returned alongside the output and
+both trainer paths add ``moe_aux_coef * aux`` to the objective; under EP it
+is computed per token-shard and averaged (the standard distributed
+convention — differs from the global-batch product only at O(1e-4) on
+balanced batches).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+# "auto" switches einsum -> sort once the [A, X, C] dispatch tensor would
+# exceed this many elements (64 MiB of f32).
+_AUTO_EINSUM_LIMIT = 16 * 1024 * 1024
 
 
 def expert_capacity(
@@ -45,65 +72,34 @@ def expert_capacity(
     return max(1, int(tokens * capacity_factor / n_experts + 0.999999))
 
 
-def moe_mlp(
-    x: jax.Array,  # [B, T, D]
-    params: dict,  # router [D, X]; w_in [X, D, F]; w_out [X, F, D];
-    #               optional w_gate [X, D, F] (SwiGLU experts)
-    *,
-    activation,
-    capacity_factor: float = 1.25,
-    expert_axis: str | None = None,
-) -> tuple[jax.Array, jax.Array]:
-    """Returns (output [B, T, D], aux_loss scalar).
+def _route(xt: jax.Array, router: jax.Array, top_k: int):
+    """Router forward: returns (expert_idx [T,K], gates [T,K], probs [T,X]).
 
-    aux_loss is the Switch load-balancing term: X * sum_e(fraction_e *
-    mean_prob_e), minimised (=1) by uniform routing.
+    f32 softmax for stability. top_k=1 keeps Switch gating (raw prob);
+    top_k>1 renormalises the selected probs (GShard).
     """
-    b, t, d = x.shape
-    n_tokens = b * t
-    xt = x.reshape(n_tokens, d)
-    n_experts = params["router"].shape[-1]
-
-    # --- routing (f32 for a stable softmax) ------------------------------
-    logits = (
-        xt.astype(jnp.float32) @ params["router"].astype(jnp.float32)
-    )  # [T, X]
+    logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)  # [T, X]
     probs = jax.nn.softmax(logits, axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=-1)[:, 0]
+    if top_k == 1:
+        idx = jnp.argmax(probs, axis=-1)[:, None]  # [T, 1]
+        gates = jnp.take_along_axis(probs, idx, axis=-1)  # [T, 1]
+    else:
+        gates, idx = jax.lax.top_k(probs, top_k)  # [T, K]
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+    return idx, gates, probs
 
-    one_hot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
-    # Position of each token within its expert's queue (0-based).
-    pos_in_expert = (jnp.cumsum(one_hot, axis=0) - one_hot) * one_hot
-    pos = jnp.sum(pos_in_expert, axis=-1).astype(jnp.int32)  # [T]
-    cap = expert_capacity(n_tokens, n_experts, capacity_factor)
-    keep = pos < cap
 
-    # Switch aux loss: fraction of tokens per expert x mean router prob.
-    fraction = jnp.mean(one_hot, axis=0)
-    mean_prob = jnp.mean(probs, axis=0)
-    aux_loss = n_experts * jnp.sum(fraction * mean_prob)
-
-    # --- dispatch: [T, X, C] one-hot scatter -----------------------------
-    dispatch = (
-        one_hot * keep[:, None]
-    )[:, :, None] * jax.nn.one_hot(pos, cap, dtype=jnp.float32)[:, None, :]
-    expert_in = jnp.einsum(
-        "txc,td->xcd", dispatch, xt.astype(jnp.float32)
-    ).astype(x.dtype)  # [X, C, D]
-
+def _expert_compute(expert_in, params, activation, expert_axis):
+    """[X, C, D] expert batches -> [X, C, D] outputs, with the EP
+    all_to_all pair when expert_axis is set. Dense experts:
+    act(x @ w_in) @ w_out; gated (SwiGLU) experts with "w_gate":
+    (act(x @ w_gate) * (x @ w_in)) @ w_out."""
     if expert_axis is not None:
         # Send each expert's slots to its owning shard; slots from all
         # shards concatenate along the capacity dim.
         expert_in = jax.lax.all_to_all(
             expert_in, expert_axis, split_axis=0, concat_axis=1, tiled=True
         )  # [X/n, n*C, D]
-
-    # --- expert compute: batched matmuls ---------------------------------
-    # Dense-style experts: act(x @ w_in) @ w_out (gpt2 family).
-    # Gated (SwiGLU) experts, params include "w_gate":
-    # (act(x @ w_gate) * (x @ w_in)) @ w_out (llama family; w_in is the
-    # up-projection).
     h = jnp.einsum(
         "xcd,xdf->xcf", expert_in, params["w_in"].astype(expert_in.dtype)
     )
@@ -118,15 +114,158 @@ def moe_mlp(
     expert_out = jnp.einsum(
         "xcf,xfd->xcd", h, params["w_out"].astype(h.dtype)
     )
-
     if expert_axis is not None:
         expert_out = jax.lax.all_to_all(
             expert_out, expert_axis, split_axis=1, concat_axis=0, tiled=True
         )  # back to [X, C, D]
+    return expert_out
 
-    # --- combine: gather each token's slot, scale by its gate ------------
-    combine = dispatch * gate[:, None, None]
-    out = jnp.einsum(
-        "txc,xcd->td", combine, expert_out.astype(jnp.float32)
+
+def _assignment_positions(e_flat: jax.Array, n_experts: int):
+    """Position of each assignment within its expert's queue (0-based),
+    priority = assignment order. Returns positions WITHOUT materialising
+    a [A, X] cumsum when used by the sort path's caller.
+
+    Sort-free formulation used by the einsum path would be the one-hot
+    cumsum; here we compute it via stable sort + segment arithmetic so
+    both paths share identical priority semantics."""
+    a = e_flat.shape[0]
+    order = jnp.argsort(e_flat, stable=True)  # assignment order preserved
+    e_sorted = e_flat[order]
+    counts = jnp.bincount(e_flat, length=n_experts)  # [X]
+    starts = jnp.cumsum(counts) - counts  # exclusive cumsum
+    pos_sorted = jnp.arange(a) - starts[e_sorted]
+    # Scatter positions back to assignment order.
+    pos = jnp.zeros((a,), jnp.int32).at[order].set(pos_sorted.astype(jnp.int32))
+    return pos, order, e_sorted, pos_sorted
+
+
+def _dispatch_einsum(
+    xt, expert_idx, gates, n_experts, cap, params, activation, expert_axis,
+    out_dtype,
+):
+    """One-hot einsum dispatch (exact-parity / teaching path)."""
+    t, k = expert_idx.shape
+    a = t * k
+    e_flat = expert_idx.reshape(a)
+    pos, _, _, _ = _assignment_positions(e_flat, n_experts)
+    keep = (pos < cap).astype(jnp.float32)
+
+    onehot_e = jax.nn.one_hot(e_flat, n_experts, dtype=jnp.float32)
+    onehot_c = jax.nn.one_hot(pos, cap, dtype=jnp.float32)
+    # [A, X, C]: the textbook dispatch tensor.
+    dispatch_a = (onehot_e * keep[:, None])[:, :, None] * onehot_c[:, None, :]
+    dispatch = dispatch_a.reshape(t, k, n_experts, cap).sum(axis=1)
+    combine = (
+        dispatch_a * gates.reshape(a)[:, None, None]
+    ).reshape(t, k, n_experts, cap).sum(axis=1)
+
+    expert_in = jnp.einsum(
+        "txc,td->xcd", dispatch, xt.astype(jnp.float32)
+    ).astype(out_dtype)  # [X, C, D]
+    expert_out = _expert_compute(expert_in, params, activation, expert_axis)
+    out = jnp.einsum("txc,xcd->td", combine, expert_out.astype(jnp.float32))
+    return out
+
+
+def _dispatch_sort(
+    xt, expert_idx, gates, n_experts, cap, params, activation, expert_axis,
+    out_dtype,
+):
+    """Sort/segment dispatch: no [A, X, C] tensor, same semantics."""
+    t, k = expert_idx.shape
+    a = t * k
+    d = xt.shape[-1]
+    e_flat = expert_idx.reshape(a)
+    tok_flat = jnp.repeat(jnp.arange(t), k)  # token of each assignment
+    gate_flat = gates.reshape(a).astype(jnp.float32)
+
+    _, order, e_sorted, pos_sorted = _assignment_positions(e_flat, n_experts)
+    keep_sorted = pos_sorted < cap
+    slot_sorted = e_sorted * cap + pos_sorted.astype(jnp.int32)  # [A]
+    tok_sorted = tok_flat[order]
+    gate_sorted = gate_flat[order]
+
+    # Scatter kept assignments' token vectors into expert batches. Each
+    # kept (expert, pos) pair is unique -> plain set; dropped assignments
+    # get an out-of-range index and mode="drop" discards them.
+    slot_or_oob = jnp.where(keep_sorted, slot_sorted, n_experts * cap)
+    expert_in = (
+        jnp.zeros((n_experts * cap, d), out_dtype)
+        .at[slot_or_oob]
+        .set(xt[tok_sorted].astype(out_dtype), mode="drop")
+        .reshape(n_experts, cap, d)
     )
+
+    expert_out = _expert_compute(expert_in, params, activation, expert_axis)
+
+    # Combine: each assignment gathers its slot's output, scaled by its
+    # gate (0 for dropped), and segment-sums into its token.
+    vals = expert_out.reshape(n_experts * cap, d).astype(jnp.float32)[
+        jnp.minimum(slot_sorted, n_experts * cap - 1)
+    ]
+    weight = jnp.where(keep_sorted, gate_sorted, 0.0)[:, None]
+    out = (
+        jnp.zeros((t, d), jnp.float32).at[tok_sorted].add(vals * weight)
+    )
+    return out
+
+
+def moe_mlp(
+    x: jax.Array,  # [B, T, D]
+    params: dict,  # router [D, X]; w_in [X, D, F]; w_out [X, F, D];
+    #               optional w_gate [X, D, F] (SwiGLU experts)
+    *,
+    activation,
+    capacity_factor: float = 1.25,
+    expert_axis: str | None = None,
+    top_k: int = 1,
+    dispatch_impl: str = "auto",
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (output [B, T, D], aux_loss scalar).
+
+    aux_loss is the Switch load-balancing term: X * sum_e(fraction_e *
+    mean_prob_e) over FIRST-choice assignments, minimised (=1) by uniform
+    routing.
+    """
+    b, t, d = x.shape
+    n_tokens = b * t
+    xt = x.reshape(n_tokens, d)
+    n_experts = params["router"].shape[-1]
+    if not (1 <= top_k <= n_experts):
+        raise ValueError(f"top_k={top_k} out of range for {n_experts} experts")
+
+    expert_idx, gates, probs = _route(xt, params["router"], top_k)
+
+    # Capacity scales with the ASSIGNMENT count (GShard/t5x convention):
+    # top-k routing produces k*T assignments, so per-expert slots must be
+    # ceil(k*T*cf/X) or a perfectly balanced top-2 router would drop ~40%
+    # of second choices at the default capacity factor.
+    cap = expert_capacity(n_tokens * top_k, n_experts, capacity_factor)
+
+    # Switch aux loss on first choices.
+    first_onehot = jax.nn.one_hot(
+        expert_idx[:, 0], n_experts, dtype=jnp.float32
+    )
+    fraction = jnp.mean(first_onehot, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux_loss = n_experts * jnp.sum(fraction * mean_prob)
+
+    if dispatch_impl == "auto":
+        a = n_tokens * top_k
+        dispatch_impl = (
+            "einsum" if a * n_experts * cap <= _AUTO_EINSUM_LIMIT else "sort"
+        )
+    if dispatch_impl == "einsum":
+        out = _dispatch_einsum(
+            xt, expert_idx, gates, n_experts, cap, params, activation,
+            expert_axis, x.dtype,
+        )
+    elif dispatch_impl == "sort":
+        out = _dispatch_sort(
+            xt, expert_idx, gates, n_experts, cap, params, activation,
+            expert_axis, x.dtype,
+        )
+    else:
+        raise ValueError(f"unknown dispatch_impl {dispatch_impl!r}")
     return out.astype(x.dtype).reshape(b, t, d), aux_loss

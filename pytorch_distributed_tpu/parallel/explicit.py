@@ -122,10 +122,19 @@ def make_explicit_train_step(
     mesh: Mesh,
     mesh_cfg: MeshConfig,
     state: TrainState,
+    *,
+    grad_clip_norm: float | None = None,
 ) -> Callable:
     """Build a jitted explicit-collective (state, batch, key) -> (state,
     metrics) step. State must already be placed per
-    parallel.sharding.shard_train_state (same shardings as the pjit path)."""
+    parallel.sharding.shard_train_state (same shardings as the pjit path).
+
+    ``grad_clip_norm``: global-norm gradient clipping, computed from the
+    psum'd global norm (shards see the SAME clip scale). The ``tx`` passed
+    in must be clip-free (``make_optimizer(cfg, with_clip=False)``) —
+    ``optax.clip_by_global_norm`` inside shard_map would compute a
+    shard-local norm on fsdp-sharded grads, silently applying a different
+    scale per shard."""
     tensor_axis = "tensor" if mesh_cfg.tensor > 1 else None
     seq_axis = "seq" if mesh_cfg.seq > 1 else None
     expert_axis = "expert" if mesh_cfg.expert > 1 else None
@@ -139,14 +148,10 @@ def make_explicit_train_step(
                 f"n_experts={model_cfg.n_experts} not divisible by "
                 f"expert={mesh_cfg.expert}"
             )
-        if (
-            mesh_cfg.strategy != "no_shard"
-            or mesh_cfg.tensor > 1
-            or mesh_cfg.seq > 1
-        ):
+        if mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
             raise NotImplementedError(
-                "expert parallelism composes with the data axis "
-                "(strategy=no_shard) only for now"
+                "expert parallelism composes with the data and fsdp axes "
+                "(any ZeRO strategy), not with tensor/seq, for now"
             )
     if seq_axis is not None and model_cfg.attn_pdrop > 0:
         # Fail at build time, not mid-trace on the first step (ring attention
@@ -323,24 +328,28 @@ def make_explicit_train_step(
             if "data" in dp_axes and mesh_cfg.data > 1:
                 grads = jax.lax.pmean(grads, "data")
         else:
-            # DDP: one all-reduce(AVG) over every batch axis. Expert
-            # parallelism first: expert-sharded leaves already hold the SUM
-            # over all expert-shards' tokens (the backward all_to_all routed
-            # every token's contribution to its expert's owner) — normalise
-            # by the shard count; everything else is a per-shard partial
-            # needing a real pmean over the expert axis.
-            if expert_axis is not None:
-                grads = jax.tree.map(
-                    lambda g, spec: (
-                        g / mesh_cfg.expert
-                        if _spec_has(spec, "expert")
-                        else jax.lax.pmean(g, expert_axis)
-                    ),
-                    grads,
-                    p_specs,
-                )
+            # DDP: one all-reduce(AVG) over every batch axis.
             for ax in dp_axes:
                 grads = jax.lax.pmean(grads, ax)
+
+        # Expert-axis reduction — orthogonal to the ZeRO level, applied
+        # under every strategy: expert-sharded leaves already hold the SUM
+        # over all expert-shards' tokens (the backward all_to_all routed
+        # every token's contribution to its expert's owner) — normalise by
+        # the shard count; everything else is a per-shard partial needing a
+        # real pmean over the expert axis. (Under full_shard the fsdp
+        # normalisation above already ran per-leaf; the two axes reduce
+        # independently.)
+        if expert_axis is not None:
+            grads = jax.tree.map(
+                lambda g, spec: (
+                    g / mesh_cfg.expert
+                    if _spec_has(spec, "expert")
+                    else jax.lax.pmean(g, expert_axis)
+                ),
+                grads,
+                p_specs,
+            )
 
         # Context parallelism: params are replicated across "seq", each shard
         # computed grads of its local-token mean loss — the global-mean grad
@@ -355,32 +364,10 @@ def make_explicit_train_step(
         if expert_axis is not None:
             loss = jax.lax.pmean(loss, expert_axis)
 
-        # --- update -------------------------------------------------------
-        if strategy == "shard_grad_op" and fsdp_size > 1:
-            # Sharded Adam update, then re-gather full params.
-            params_shard = jax.tree.map(
-                lambda p, spec: _shard_slice(p, spec, fsdp_size),
-                state.params,
-                shard_specs,
-            )
-            updates, new_opt_state = tx.update(
-                grads, state.opt_state, params_shard
-            )
-            new_params_shard = optax.apply_updates(params_shard, updates)
-            new_params = jax.tree.map(
-                lambda s, full, spec: _unscatter(s, full, spec),
-                new_params_shard, state.params, shard_specs,
-            )
-        else:
-            updates, new_opt_state = tx.update(
-                grads, state.opt_state, state.params
-            )
-            new_params = optax.apply_updates(state.params, updates)
-
         # grad_norm over the distributed grad tree: each leaf's squared sum
         # is psum'd over exactly the axes that leaf is sharded over (fsdp
         # and/or tensor); leaves replicated on an axis must NOT be summed
-        # over it.
+        # over it. Computed BEFORE the update so it can drive clipping.
         norm_specs = (
             shard_specs
             if strategy in ("full_shard", "shard_grad_op") and fsdp_size > 1
@@ -405,6 +392,44 @@ def make_explicit_train_step(
                 val = jax.lax.psum(val, ax)
             sq = sq + val
         grad_norm = jnp.sqrt(sq)
+
+        if grad_clip_norm is not None:
+            # optax.clip_by_global_norm semantics against the GLOBAL norm:
+            # identity when under the threshold, uniform (g/norm)*max scale
+            # when over — the same scale on every shard. The (invariant)
+            # norm is pcast up to each leaf's vma before mixing.
+            def clip_leaf(g):
+                gn = pvary_missing(
+                    grad_norm,
+                    tuple(getattr(g.aval, "vma", frozenset())),
+                )
+                return jnp.where(
+                    gn < grad_clip_norm, g, (g / gn) * grad_clip_norm
+                )
+
+            grads = jax.tree.map(clip_leaf, grads)
+
+        # --- update -------------------------------------------------------
+        if strategy == "shard_grad_op" and fsdp_size > 1:
+            # Sharded Adam update, then re-gather full params.
+            params_shard = jax.tree.map(
+                lambda p, spec: _shard_slice(p, spec, fsdp_size),
+                state.params,
+                shard_specs,
+            )
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, params_shard
+            )
+            new_params_shard = optax.apply_updates(params_shard, updates)
+            new_params = jax.tree.map(
+                lambda s, full, spec: _unscatter(s, full, spec),
+                new_params_shard, state.params, shard_specs,
+            )
+        else:
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
 
         metrics = {"loss": loss, "grad_norm": grad_norm}
         return TrainState(new_params, new_opt_state, state.step + 1), metrics

@@ -17,10 +17,14 @@ LM head + loss (gated with ``lax.cond`` so other stages skip the
 vocab-sized matmul); bubble ticks compute on garbage whose loss contribution
 — and therefore gradient — is exactly zero.
 
-Composes with the data axis (DDP): batch rows shard over "data", grads
-pmean over it. Deterministic mode only (dropout configs are rejected at
-build time, like the ring/TP paths). fsdp/tensor/seq composition inside a
-stage is future work — rejected explicitly.
+Composes with the data axis (DDP: batch rows shard over "data", grads
+pmean over it) and with in-stage ZeRO-3 (strategy="full_shard", fsdp > 1:
+stage params/opt-state additionally shard over "fsdp", each scanned layer
+all_gathers just in time inside the rematted body and the gather's AD
+transpose reduce-scatters the grads — the same machinery as
+parallel/explicit.py). Deterministic mode only (dropout configs are
+rejected at build time, like the ring/TP paths). tensor/seq composition
+inside a stage is future work — rejected explicitly.
 
 Typed under check_vma: block params vary over "pipe" (sharded), replicated
 leaves (embeddings, final norm, head) are pvaried for local differentiation
@@ -52,13 +56,43 @@ from pytorch_distributed_tpu.train.state import TrainState
 
 def pipeline_state_specs(state: TrainState, mesh_cfg: MeshConfig):
     """Block leaves shard their stacked layer dim over "pipe"; everything
-    else replicates. Optimizer moments mirror the params tree."""
+    else replicates over pipe. Optimizer moments mirror the params tree.
+
+    In-stage ZeRO-3 (strategy="full_shard" with fsdp > 1): every leaf
+    additionally shards its largest remaining divisible weight dim over
+    "fsdp" — block leaves never their (pipe-owned) layer dim, embedding
+    tables never their vocab/position dim (same rules as
+    parallel/sharding.py)."""
+    fsdp = mesh_cfg.fsdp if mesh_cfg.strategy == "full_shard" else 1
 
     def spec_for(path, leaf):
         keys = [getattr(p, "key", None) for p in path]
-        if "blocks" in keys and getattr(leaf, "ndim", 0) >= 1:
-            return P("pipe", *([None] * (leaf.ndim - 1)))
-        return P()
+        ndim = getattr(leaf, "ndim", 0)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if ndim == 0:
+            return P()
+        spec: list = [None] * ndim
+        stacked = "blocks" in keys
+        if stacked:
+            spec[0] = "pipe"
+        if fsdp > 1:
+            embedding = bool(keys) and keys[-1] in ("wte", "wpe")
+            min_dim = 1 if (stacked or embedding) else 0
+            best_dim, best_size = None, 0
+            for i, s in enumerate(shape):
+                if (
+                    i >= min_dim
+                    and spec[i] is None
+                    and s % fsdp == 0
+                    and s >= best_size
+                    and s > 1
+                ):
+                    best_dim, best_size = i, s
+            if best_dim is not None:
+                spec[best_dim] = "fsdp"
+        if all(ax is None for ax in spec):
+            return P()
+        return P(*spec)
 
     p_specs = jax.tree_util.tree_map_with_path(spec_for, state.params)
     o_specs = jax.tree_util.tree_map_with_path(spec_for, state.opt_state)
@@ -100,10 +134,15 @@ def make_pipeline_train_step(
             "grad_clip_norm is not supported on the pipeline path: the clip "
             "scale must be computed from a pipe-aware global norm"
         )
-    if mesh_cfg.fsdp > 1 or mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
+    if mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
         raise NotImplementedError(
-            "pipeline composes with the data axis only (in-stage "
-            "fsdp/tensor/seq sharding is future work)"
+            "pipeline composes with the data and fsdp axes (in-stage "
+            "tensor/seq sharding is future work)"
+        )
+    if mesh_cfg.fsdp > 1 and mesh_cfg.strategy != "full_shard":
+        raise NotImplementedError(
+            "pipeline + fsdp supports strategy='full_shard' (in-stage "
+            "ZeRO-3) only"
         )
     if (
         model_cfg.embd_pdrop > 0
@@ -125,21 +164,60 @@ def make_pipeline_train_step(
             f"pipe={n_stages} stages"
         )
     data_axis = "data" if mesh_cfg.data > 1 else None
+    fsdp_size = mesh_cfg.fsdp
     # No wrap-around pair: stage 0 always takes the embed branch, so shipping
     # the last stage's activation back to it would be a wasted hop; ppermute
     # delivers zeros to stages with no source, which stage 0 ignores.
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     specs = pipeline_state_specs(state, mesh_cfg)
-    batch_spec = P(None, "data" if mesh_cfg.data > 1 else None, None)
+    # fsdp is data parallelism with sharded state: batch rows split over it.
+    batch_axes = tuple(
+        ax for ax in ("data", "fsdp") if getattr(mesh_cfg, ax) > 1
+    ) or None
+    batch_spec = P(None, batch_axes, None)
 
-    vary_axes = ("pipe",) + (("data",) if data_axis else ())
+    vary_axes = ("pipe",) + tuple(
+        ax for ax in ("data", "fsdp") if getattr(mesh_cfg, ax) > 1
+    )
 
     def _vary(x):
         return pvary_missing(x, vary_axes)
 
+    if fsdp_size > 1:
+        # In-stage ZeRO-3: non-block leaves gather up front; each scanned
+        # layer gathers its own block slice just in time inside the
+        # (rematted) scan body — backward re-gathers and the gather's AD
+        # transpose IS the gradient reduce-scatter (same machinery as
+        # parallel/explicit.py, whose helpers are reused).
+        from pytorch_distributed_tpu.parallel.explicit import (
+            _gather_params,
+        )
+
+        block_specs = jax.tree.map(
+            lambda s: P(*s[1:]),
+            specs.params["blocks"],
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+        def gather_block(bp):
+            return _gather_params(bp, block_specs)
+
+        def gather_nonblock(params):
+            return {
+                k: (v if k == "blocks" else _gather_params(v, specs.params[k]))
+                for k, v in params.items()
+            }
+
+    else:
+        gather_block = None
+
+        def gather_nonblock(params):
+            return params
+
     def forward_loss(params, inputs_mb, targets_mb):
         """Pipelined forward over all M microbatches; mean loss."""
+        params = gather_nonblock(params)
         m = inputs_mb.shape[0]
         b, t = inputs_mb.shape[1], inputs_mb.shape[2]
         stage = jax.lax.axis_index("pipe")
@@ -159,7 +237,10 @@ def make_pipeline_train_step(
                 ),
                 lambda: x_buf,
             )
-            y = model.run_blocks(params["blocks"], x_in, model_cfg)
+            y = model.run_blocks(
+                params["blocks"], x_in, model_cfg,
+                block_transform=gather_block,
+            )
             out_idx = tk - (n_stages - 1)
             valid_out = (stage == n_stages - 1) & (out_idx >= 0)
             loss_t = jax.lax.cond(
@@ -204,6 +285,21 @@ def make_pipeline_train_step(
             grads,
             specs.params,
         )
+        if fsdp_size > 1:
+            # fsdp-sharded leaves: the gather's AD transpose SUMMED the
+            # per-shard grads over fsdp (reduce-scatter) — normalise to a
+            # mean; leaves with no fsdp dim are per-shard partials over the
+            # fsdp batch slice — a real pmean.
+            grads = jax.tree.map(
+                lambda g, spec: (
+                    g / fsdp_size
+                    if _has_axis(spec, "fsdp")
+                    else jax.lax.pmean(g, "fsdp")
+                ),
+                grads,
+                specs.params,
+            )
+            loss = jax.lax.pmean(loss, "fsdp")
         if data_axis:
             grads = jax.lax.pmean(grads, data_axis)
             loss = jax.lax.pmean(loss, data_axis)
@@ -211,22 +307,29 @@ def make_pipeline_train_step(
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
-        sq_sharded = jnp.zeros((), jnp.float32)
-        sq_repl = jnp.zeros((), jnp.float32)
+        # Per-leaf squared sums psum'd over exactly the axes the leaf is
+        # sharded over (pipe and/or fsdp); replicated leaves unsummed.
+        buckets: dict = {}
         for g, spec in zip(
             jax.tree.leaves(grads),
             jax.tree.leaves(
                 specs.params, is_leaf=lambda x: isinstance(x, P)
             ),
         ):
-            s = jnp.sum(jnp.square(g.astype(jnp.float32)))
-            if _has_pipe(spec):
-                sq_sharded = sq_sharded + s
-            else:
-                sq_repl = sq_repl + s
-        grad_norm = jnp.sqrt(
-            jax.lax.psum(sq_sharded, "pipe") + sq_repl
-        )
+            axes = tuple(
+                ax for ax in ("pipe", "fsdp")
+                if _has_axis(spec, ax)
+                and (ax != "fsdp" or fsdp_size > 1)
+            )
+            buckets[axes] = buckets.get(axes, 0.0) + jnp.sum(
+                jnp.square(g.astype(jnp.float32))
+            )
+        sq = jnp.zeros((), jnp.float32)
+        for axes, val in buckets.items():
+            for ax in axes:
+                val = jax.lax.psum(val, ax)
+            sq = sq + val
+        grad_norm = jnp.sqrt(sq)
         metrics = {"loss": loss, "grad_norm": grad_norm}
         return TrainState(new_params, new_opt_state, state.step + 1), metrics
 
@@ -244,8 +347,12 @@ def make_pipeline_train_step(
     return jax.jit(smapped, donate_argnums=(0,))
 
 
-def _has_pipe(spec: P) -> bool:
+def _has_axis(spec: P, axis: str) -> bool:
     return any(
-        entry == "pipe" or (isinstance(entry, tuple) and "pipe" in entry)
+        entry == axis or (isinstance(entry, tuple) and axis in entry)
         for entry in spec
     )
+
+
+def _has_pipe(spec: P) -> bool:
+    return _has_axis(spec, "pipe")

@@ -107,9 +107,16 @@ class DistributedTrainer(Trainer):
             return state
         state, _ = shard_train_state(state, self.mesh, self.mesh_cfg)
         if self.path == "explicit":
+            # Clip-free optimizer: optax's clip inside shard_map would see
+            # shard-LOCAL grads and compute a different clip scale per shard.
+            # The explicit step clips against the psum'd global norm itself.
+            from pytorch_distributed_tpu.train.optim import make_optimizer
+
             self.train_step = make_explicit_train_step(
-                self.model, self.model_cfg, self.tx, self.mesh,
+                self.model, self.model_cfg,
+                make_optimizer(self.train_cfg, with_clip=False), self.mesh,
                 self.mesh_cfg, state,
+                grad_clip_norm=self.train_cfg.grad_clip_norm,
             )
         else:
             self.train_step, _ = make_parallel_train_step(

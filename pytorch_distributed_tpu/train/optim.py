@@ -51,10 +51,19 @@ def lr_at_step(cfg: TrainConfig, step: int) -> float:
     return floor + (peak - floor) * 0.5 * (1.0 + math.cos(math.pi * frac))
 
 
-def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+def make_optimizer(
+    cfg: TrainConfig, *, with_clip: bool = True
+) -> optax.GradientTransformation:
+    """``with_clip=False`` swaps the clip element for ``optax.identity()``
+    (same empty state, so opt-state trees stay checkpoint-compatible).
+    Callers that run the update inside ``shard_map`` with sharded grads
+    (parallel/explicit.py) MUST pass ``with_clip=False`` and clip against
+    the psum'd global norm themselves — ``optax.clip_by_global_norm`` seen
+    per-shard computes a shard-local norm, a different clip scale per
+    shard."""
     steps = [
         optax.clip_by_global_norm(cfg.grad_clip_norm)
-        if cfg.grad_clip_norm is not None
+        if (with_clip and cfg.grad_clip_norm is not None)
         else optax.identity(),
         optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps),
         optax.add_decayed_weights(cfg.weight_decay),

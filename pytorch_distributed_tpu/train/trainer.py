@@ -348,15 +348,21 @@ class Trainer:
             except ValueError:
                 restore_handlers = []  # not the main thread: no handlers
 
-        def stop_requested() -> bool:
+        def stop_requested(*, force_sync: bool = False) -> bool:
             # Multi-host: the signal lands on individual processes at
             # different step boundaries; all processes must agree on ONE
             # stop step or the collective checkpoint save deadlocks. The
-            # allgather runs at the same loop point on every process, so
-            # OR-ing the flags yields a common decision.
+            # allgather runs at the same loop point on every process (all
+            # processes hold the same `step` under lockstep loaders), so
+            # OR-ing the flags yields a common decision. Syncs are gated to
+            # every preemption_sync_every_n_steps — in between, the local
+            # flag is DEFERRED (not acted on) so no process breaks alone.
             if not cfg.save_on_preemption:
                 return False
             if jax.process_count() > 1:
+                n = max(1, cfg.preemption_sync_every_n_steps)
+                if not force_sync and step % n != 0:
+                    return False  # defer to the next common sync point
                 from jax.experimental import multihost_utils
 
                 flags = multihost_utils.process_allgather(
@@ -427,8 +433,11 @@ class Trainer:
                     signal.signal(sig, prev)
         # NOT short-circuited on the local flag: every process must run the
         # same number of stop_requested() collectives, and must join the
-        # collective save when ANY process was signalled.
-        if cfg.save_on_preemption and stop_requested():
+        # collective save when ANY process was signalled. force_sync: this
+        # final decision always syncs (exactly once per process) even when
+        # the in-loop cadence is gated, so a signal deferred past the last
+        # loop iteration is still honoured.
+        if cfg.save_on_preemption and stop_requested(force_sync=True):
             self._log(
                 f"preemption signal received: checkpointing at step {step}"
             )

@@ -14,6 +14,10 @@ import pytest
 from pytorch_distributed_tpu.config import ModelConfig
 from pytorch_distributed_tpu.models import decode, get_model
 
+# Heavy tier: long-compiling / multi-process file; excluded from
+# `pytest -m quick` (see tests/conftest.py + pyproject markers).
+pytestmark = pytest.mark.full
+
 
 def _cfg(family, **kw):
     extra = {"n_kv_head": 2} if family == "llama" else {}
@@ -122,3 +126,15 @@ def test_generate_top_k_restricts_support():
         top_k=1,
     )
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+
+def test_generate_zero_new_tokens_returns_prompt():
+    """max_new_tokens=0 must return the prompt unchanged, not crash on a
+    static out-of-bounds write (advisor finding, round 2)."""
+    cfg = _cfg("gpt2")
+    params = get_model(cfg).init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, cfg.vocab_size)
+    out = decode.generate(params, prompt, cfg, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+    with pytest.raises(ValueError, match=">= 0"):
+        decode.generate(params, prompt, cfg, -1)

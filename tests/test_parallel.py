@@ -35,6 +35,10 @@ from pytorch_distributed_tpu.train.state import init_train_state
 from pytorch_distributed_tpu.train.trainer import make_train_step
 from pytorch_distributed_tpu.utils.prng import domain_key
 
+# Heavy tier: long-compiling / multi-process file; excluded from
+# `pytest -m quick` (see tests/conftest.py + pyproject markers).
+pytestmark = pytest.mark.full
+
 
 @pytest.fixture(scope="module")
 def setup(eight_devices):
@@ -379,6 +383,54 @@ def test_batch_partition_spec():
     ) == P(None, ("data", "fsdp"), None)
     assert batch_partition_spec(MeshConfig()) == P(None, None, None)
     assert data_parallel_size(MeshConfig(data=2, fsdp=4)) == 8
+
+
+CLIP_CONFIGS = [
+    ("no_shard", 8, 1),
+    ("full_shard", 1, 8),
+    ("full_shard", 2, 4),
+    ("shard_grad_op", 1, 8),
+]
+
+
+@pytest.mark.parametrize("strategy,data,fsdp", CLIP_CONFIGS)
+def test_explicit_grad_clip_matches_single_device(setup, strategy, data, fsdp):
+    """Global-norm clipping on the explicit path must clip against the
+    GLOBAL norm (psum over the sharded axes), not the shard-local norm —
+    verified by equivalence against the single-device optax
+    clip_by_global_norm step with a threshold low enough to trigger."""
+    cfg, model = setup["cfg"], setup["model"]
+    clip = 0.5 * setup["ref_gnorm"]  # guaranteed to trigger
+    tcfg = TrainConfig(
+        global_batch_size=16, micro_batch_size=16, num_steps=4,
+        learning_rate=1e-3, grad_clip_norm=clip,
+    )
+    tx_clip = make_optimizer(tcfg)
+    state0 = init_train_state(model.init(domain_key(42, "init"), cfg), tx_clip)
+    ref_state, ref_m = make_train_step(model, cfg, tx_clip, donate=False)(
+        state0, setup["batch"], jax.random.key(0)
+    )
+
+    mcfg = MeshConfig(data=data, fsdp=fsdp, strategy=strategy)
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx_clip)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    step = make_explicit_train_step(
+        model, cfg, make_optimizer(tcfg, with_clip=False), mesh, mcfg, state,
+        grad_clip_norm=clip,
+    )
+    new_state, m = step(state, make_batch_put(mesh, mcfg)(setup["batch"]),
+                        jax.random.key(0))
+    assert float(m["loss"]) == pytest.approx(float(ref_m["loss"]), abs=1e-5)
+    # Reported grad_norm is pre-clip on both paths.
+    assert float(m["grad_norm"]) == pytest.approx(
+        float(ref_m["grad_norm"]), abs=1e-4
+    )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(ref_state.params)),
+        jax.tree.leaves(jax.device_get(new_state.params)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
 def test_mesh_too_big_rejected(eight_devices):
