@@ -60,6 +60,26 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
                         "reference has at trainer level but never wires up)")
     p.add_argument("--dtype", default=None,
                    help="activation dtype override (bfloat16/float32)")
+    p.add_argument("--param-dtype", default=None,
+                   help="parameter/optimizer-state dtype override. A 774M+ "
+                        "model with f32 master state cannot fit one 16 GB "
+                        "v5e chip; the verified single-v5e gpt2-large recipe "
+                        "is --dtype bfloat16 --param-dtype bfloat16 "
+                        "--global-batch-size 4 --micro-batch-size 4 (no "
+                        "accumulation — the f32 accumulator buffers are what "
+                        "overflow). The reference's global-batch-32 config "
+                        "belongs on a multi-chip fsdp mesh (train_fsdp.py / "
+                        "train_parallel.py)")
+    p.add_argument("--attention-impl", default="flash",
+                   choices=["flash", "naive"],
+                   help="flash (Pallas/blockwise, O(T) memory — default) or "
+                        "naive (reference-parity [T,T] scores; with --remat "
+                        "dots the saved f32 scores OOM any >12-layer model "
+                        "at T=1024 on a 16 GB chip)")
+    p.add_argument("--remat", default="names",
+                   choices=["none", "full", "dots", "dots_no_batch", "names"],
+                   help="activation-checkpoint policy (names = save tagged "
+                        "projection outputs, the measured optimum — default)")
     p.add_argument("--no-profiler", action="store_true")
     p.add_argument("--trace-dir", default=None)
     p.add_argument("--cpu-devices", type=int, default=0,
@@ -95,6 +115,15 @@ def build_model_cfg(args):
         cfg = cfg.replace(n_ctx=max(args.seq_len, 32))
     if args.dtype:
         cfg = cfg.replace(dtype=args.dtype)
+    if getattr(args, "param_dtype", None):
+        cfg = cfg.replace(param_dtype=args.param_dtype)
+    # Unconditional: entry scripts default to the TPU-sane flash/names
+    # combination (the ModelConfig defaults are the reference-parity
+    # naive/dots, which OOM any >12-layer model at T=1024 on 16 GB);
+    # argparse always supplies a value, so there is no "unset" case.
+    cfg = cfg.replace(
+        attention_impl=args.attention_impl, remat=args.remat
+    )
     if args.seq_len > cfg.n_ctx:
         raise SystemExit(
             f"--seq-len {args.seq_len} exceeds model n_ctx {cfg.n_ctx}"

@@ -1,0 +1,117 @@
+"""A/B perf experiments on the real chip (bench.py methodology).
+
+Times the GPT-2 124M bench config under config variants (e.g. scan-unroll
+factors) with fresh seeds and long fenced windows — the measurement-hygiene
+rules from benchmarks/PERF_NOTES.md. One JSON line per variant.
+
+Usage:
+  python scripts/perf_ab.py --variants unroll1,unroll2,unroll4
+  python scripts/perf_ab.py --variants unroll1,unroll2 --windows 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+VARIANTS = {
+    "unroll1": dict(scan_unroll=1),
+    "unroll2": dict(scan_unroll=2),
+    "unroll3": dict(scan_unroll=3),
+    "unroll4": dict(scan_unroll=4),
+    "unroll6": dict(scan_unroll=6),
+    "unroll12": dict(scan_unroll=12),
+    "dots": dict(remat="dots"),
+    "no_remat": dict(remat="none"),
+    "full_remat": dict(remat="full"),
+}
+
+
+def run_variant(name: str, overrides: dict, *, windows: int,
+                window_steps: int, batch_size: int = 8,
+                seq_len: int = 1024) -> dict:
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.config import TrainConfig, model_config
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.train.trainer import make_train_step
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    seed = int.from_bytes(os.urandom(4), "little")
+    base = dict(
+        attention_impl="flash", remat="names", logits_dtype="bfloat16",
+        attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0,
+    )
+    base.update(overrides)
+    cfg = model_config("gpt2", dtype="bfloat16").replace(**base)
+    model = get_model(cfg)
+    tcfg = TrainConfig(
+        global_batch_size=batch_size, micro_batch_size=batch_size,
+        num_steps=3 + windows * window_steps, learning_rate=3e-4,
+    )
+    tx = make_optimizer(tcfg)
+    params = model.init(domain_key(seed, "init"), cfg)
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    state = init_train_state(params, tx)
+    step = make_train_step(model, cfg, tx)
+    rng = np.random.default_rng(seed)
+    batch = {
+        k: jax.numpy.asarray(
+            rng.integers(0, cfg.vocab_size, (1, batch_size, seq_len)),
+            dtype=jax.numpy.int32,
+        )
+        for k in ("inputs", "targets")
+    }
+    dkey = domain_key(seed, "dropout")
+    idx = 0
+    for _ in range(3):
+        state, m = step(state, batch, jax.random.fold_in(dkey, idx))
+        idx += 1
+    float(jax.device_get(m["loss"]))
+
+    tps = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(window_steps):
+            state, m = step(state, batch, jax.random.fold_in(dkey, idx))
+            idx += 1
+        float(jax.device_get(m["loss"]))
+        tps.append(window_steps * batch_size * seq_len /
+                   (time.perf_counter() - t0))
+    tok_s = statistics.median(tps)
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * seq_len
+    return dict(
+        variant=name,
+        tokens_per_sec=round(tok_s, 1),
+        ms_per_step=round(batch_size * seq_len / tok_s * 1e3, 2),
+        mfu_pct=round(tok_s * flops_per_token / 197e12 * 100, 2),
+        window_spread=round(max(tps) / min(tps), 3),
+    )
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variants", default="unroll1,unroll2,unroll4")
+    ap.add_argument("--windows", type=int, default=2)
+    ap.add_argument("--window-steps", type=int, default=48)
+    args = ap.parse_args()
+    for name in args.variants.split(","):
+        res = run_variant(
+            name, VARIANTS[name], windows=args.windows,
+            window_steps=args.window_steps,
+        )
+        print(json.dumps(res), flush=True)
+
+
+if __name__ == "__main__":
+    main()
