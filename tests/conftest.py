@@ -25,6 +25,15 @@ import pytest  # noqa: E402
 from pytorch_distributed_tpu.config import ModelConfig  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Two-tier suite (CI ergonomics): every test not explicitly marked
+    ``full`` gets ``quick``, so ``pytest -m quick`` runs the fast tier
+    (~5 min on this rig) and plain ``pytest`` runs everything."""
+    for item in items:
+        if "full" not in item.keywords:
+            item.add_marker(pytest.mark.quick)
+
+
 @pytest.fixture(scope="session")
 def tiny_config() -> ModelConfig:
     return ModelConfig(

@@ -13,6 +13,10 @@ from pytorch_distributed_tpu.train.checkpoint import latest_checkpoint
 from pytorch_distributed_tpu.train.distributed_trainer import DistributedTrainer
 from pytorch_distributed_tpu.train.trainer import Trainer
 
+# Heavy tier: long-compiling / multi-process file; excluded from
+# `pytest -m quick` (see tests/conftest.py + pyproject markers).
+pytestmark = pytest.mark.full
+
 
 @pytest.fixture(scope="module")
 def cfg():

@@ -18,6 +18,10 @@ import pytest
 
 from pytorch_distributed_tpu.ops.flash_kernel import flash_mha
 
+# Heavy tier: long-compiling / multi-process file; excluded from
+# `pytest -m quick` (see tests/conftest.py + pyproject markers).
+pytestmark = pytest.mark.full
+
 
 def _ref_attention(q, k, v, causal):
     b, h, t, d = q.shape

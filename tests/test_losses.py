@@ -22,6 +22,10 @@ from pytorch_distributed_tpu.train.optim import make_optimizer
 from pytorch_distributed_tpu.train.state import init_train_state
 from pytorch_distributed_tpu.train.trainer import make_train_step
 
+# Heavy tier: long-compiling / multi-process file; excluded from
+# `pytest -m quick` (see tests/conftest.py + pyproject markers).
+pytestmark = pytest.mark.full
+
 
 @pytest.mark.parametrize(
     "n,e,v,bv,layout",

@@ -7,6 +7,10 @@ from pytorch_distributed_tpu.models import gpt2
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.utils.pytree import param_count
 
+# Heavy tier: long-compiling / multi-process file; excluded from
+# `pytest -m quick` (see tests/conftest.py + pyproject markers).
+pytestmark = pytest.mark.full
+
 
 def _ids(cfg, batch=2, seed=1):
     return jax.random.randint(
@@ -14,6 +18,7 @@ def _ids(cfg, batch=2, seed=1):
     )
 
 
+@pytest.mark.quick  # representative smoke kept in the fast tier
 def test_forward_shapes_and_dtype(tiny_config):
     cfg = tiny_config
     params = gpt2.init(jax.random.key(0), cfg)
@@ -123,6 +128,7 @@ def test_shorter_sequence_ok(tiny_config):
     assert gpt2.apply(params, ids, cfg).shape == (1, 8, cfg.vocab_size)
 
 
+@pytest.mark.quick  # representative smoke kept in the fast tier
 def test_loss_near_uniform_at_init(tiny_config):
     """At init, CE should be close to ln(V) — catches scale bugs."""
     cfg = tiny_config

@@ -15,6 +15,10 @@ from pytorch_distributed_tpu.train.checkpoint import (
 )
 from pytorch_distributed_tpu.train.optim import lr_at_step, make_schedule
 
+# Heavy tier: long-compiling / multi-process file; excluded from
+# `pytest -m quick` (see tests/conftest.py + pyproject markers).
+pytestmark = pytest.mark.full
+
 
 @pytest.fixture(scope="module")
 def loader(tmp_path_factory):
@@ -42,6 +46,7 @@ def _trainer(tiny_config, **kw):
     return Trainer(model, tiny_config, cfg), cfg
 
 
+@pytest.mark.quick  # representative smoke kept in the fast tier
 def test_train_loss_decreases(tiny_config, loader):
     trainer, _ = _trainer(tiny_config, num_steps=12)
     assert trainer.accum == 2
