@@ -242,6 +242,10 @@ class TrainConfig:
     beta2: float = 0.999
     eps: float = 1e-8
     grad_clip_norm: float | None = None
+    # Exclude rank<2 params (norm scales, biases) from weight decay — the
+    # modern pretraining convention. Default OFF: the reference decays
+    # every param (torch AdamW default, train_baseline.py:61).
+    decay_exclude_1d: bool = False
     # Cosine anneal to min_lr_ratio * learning_rate over num_steps
     # (reference train_baseline.py:62-64: CosineAnnealingLR eta_min=0.1*lr).
     lr_schedule: str = "cosine"
@@ -252,6 +256,18 @@ class TrainConfig:
     log_every_n_steps: int = 10
     save_every_n_steps: int | None = None
     checkpoint_dir: str = "checkpoints"
+    # Retain only the newest N checkpoints (None = keep all, the
+    # reference's behavior). Pruning runs on process 0 after each
+    # successful save. Validated at construction (grad_accum_steps-style
+    # late failures would kill a run at its first save).
+    keep_checkpoints: int | None = None
+
+    def __post_init__(self) -> None:
+        if self.keep_checkpoints is not None and self.keep_checkpoints < 1:
+            raise ValueError(
+                f"keep_checkpoints must be >= 1 or None, got "
+                f"{self.keep_checkpoints}"
+            )
     # Optional JSONL metrics sink: every logged window (step/loss/lr/
     # elapsed) is appended as one JSON object — machine-readable run
     # history beyond the reference's stdout prints (process 0 only under
@@ -307,19 +323,35 @@ class MeshConfig:
     # all_to_all moves token slots to their expert's owner (ops/moe.py).
     expert: int = 1
 
-    # FSDP sharding strategy, mirroring reference train_fsdp.py:49-59:
+    # FSDP sharding strategy, mirroring reference train_fsdp.py:49-59
+    # (plus the ZeRO-1 level torch FSDP lacks):
     #   "full_shard"     — params+grads+opt sharded (ZeRO-3)
     #   "shard_grad_op"  — grads+opt sharded, params replicated (ZeRO-2)
+    #   "shard_opt"      — opt sharded only; grads all-reduced replicated,
+    #                      each shard updates its slice, updated params
+    #                      re-gathered (ZeRO-1)
     #   "no_shard"       — DDP-equivalent
     strategy: str = "full_shard"
+
+    # Pipeline schedule (pipe > 1): "gpipe" (backward by AD transposition)
+    # or "1f1b" (hand-scheduled PipeDream-flush — activation stash bounded
+    # at pipe slots instead of the microbatch count; parallel/pipeline.py).
+    pipe_schedule: str = "gpipe"
 
     axis_order: tuple[str, ...] = (
         "pipe", "data", "fsdp", "expert", "seq", "tensor"
     )
 
     def __post_init__(self) -> None:
-        if self.strategy not in ("full_shard", "shard_grad_op", "no_shard"):
+        if self.strategy not in (
+            "full_shard", "shard_grad_op", "shard_opt", "no_shard"
+        ):
             raise ValueError(f"unknown FSDP strategy: {self.strategy!r}")
+        if self.pipe_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipe_schedule: {self.pipe_schedule!r} "
+                "(implemented: gpipe, 1f1b)"
+            )
 
     @property
     def num_devices(self) -> int:

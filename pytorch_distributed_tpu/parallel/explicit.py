@@ -32,6 +32,12 @@ wrappers issue imperatively:
       a psum of disjoint padded slices — numerically an all_gather, but
       typed invariant under check_vma (reference train_fsdp.py:52-53).
 
+  FSDP shard_opt (ZeRO-1):
+    - params AND grads replicated (plain all-reduce like DDP);
+    - each shard slices params+grads to its fsdp slice, runs the Adam
+      update against its optimizer-state shard, and the updated slices
+      are re-materialised — only the optimizer memory is sharded.
+
   Tensor parallelism ("tensor" axis, Megatron-style):
     - block params sharded head-/column-aligned (parallel/sharding.py);
       the model runs on local heads with the tp_copy/tp_reduce conjugate
@@ -410,15 +416,28 @@ def make_explicit_train_step(
             grads = jax.tree.map(clip_leaf, grads)
 
         # --- update -------------------------------------------------------
-        if strategy == "shard_grad_op" and fsdp_size > 1:
-            # Sharded Adam update, then re-gather full params.
+        if strategy in ("shard_grad_op", "shard_opt") and fsdp_size > 1:
+            # ZeRO-2 / ZeRO-1 shared machinery: sharded Adam update on this
+            # device's fsdp slice, then re-gather full params. They differ
+            # only in what arrives here: shard_grad_op grads were
+            # reduce-scattered above (already sharded); shard_opt grads
+            # stayed replicated (all-reduced) and are sliced now.
             params_shard = jax.tree.map(
                 lambda p, spec: _shard_slice(p, spec, fsdp_size),
                 state.params,
                 shard_specs,
             )
+            grads_for_update = (
+                grads
+                if strategy == "shard_grad_op"
+                else jax.tree.map(
+                    lambda g, spec: _shard_slice(g, spec, fsdp_size),
+                    grads,
+                    shard_specs,
+                )
+            )
             updates, new_opt_state = tx.update(
-                grads, state.opt_state, params_shard
+                grads_for_update, state.opt_state, params_shard
             )
             new_params_shard = optax.apply_updates(params_shard, updates)
             new_params = jax.tree.map(

@@ -117,11 +117,20 @@ def make_pipeline_train_step(
     mesh_cfg: MeshConfig,
     state: TrainState,
     train_cfg: TrainConfig | None = None,
+    *,
+    schedule: str = "gpipe",
 ) -> Callable:
     """Build the jitted pipelined (state, batch, key) -> (state, metrics)
     step. ``batch`` is [M, B_global, T]; M (the grad-accumulation factor)
     doubles as the pipeline microbatch count. State must be placed by
     ``shard_pipeline_state``.
+
+    ``schedule``: "gpipe" (forward scan, backward obtained by AD
+    transposition — lowest compute, activation stash grows with M) or
+    "1f1b" (hand-scheduled PipeDream-flush: backward starts as soon as a
+    microbatch clears the last stage, bounding the activation stash at S
+    slots at the cost of one full-stage recompute per backward tick).
+    Both produce identical numbers (equivalence-tested).
 
     Pass ``train_cfg`` so unsupported optimizer couplings are rejected at
     build time: gradient clipping's global norm would mix pipe-sharded and
@@ -129,6 +138,10 @@ def make_pipeline_train_step(
     otherwise)."""
     if mesh_cfg.pipe <= 1:
         raise ValueError("pipeline path needs mesh_cfg.pipe > 1")
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r} (gpipe, 1f1b)"
+        )
     if train_cfg is not None and train_cfg.grad_clip_norm:
         raise NotImplementedError(
             "grad_clip_norm is not supported on the pipeline path: the clip "
@@ -270,10 +283,145 @@ def make_pipeline_train_step(
 
     grad_fn = jax.value_and_grad(forward_loss)
 
+    def loss_and_grads_1f1b(vparams, inputs_mb, targets_mb):
+        """Hand-scheduled 1F1B (PipeDream-flush): stage s runs F(m) at tick
+        2m+s and B(m) at tick 2m+2S-1-s. F and B land on opposite tick
+        parities per stage (no conflict), every producer->consumer hop is
+        exactly one tick, and at most S-s microbatch inputs are in flight
+        on stage s — so the activation stash is S slots instead of GPipe's
+        M. AD cannot express this interleaving (transposing the forward
+        scan yields the backward as a SECOND full pass), so each B tick
+        re-runs its stage forward under ``jax.vjp`` seeded with the
+        cotangent arriving from the next stage (full-stage remat; ~1x
+        extra stage compute is the price of the S/M activation-memory
+        reduction)."""
+        m = inputs_mb.shape[0]
+        b, t = inputs_mb.shape[1], inputs_mb.shape[2]
+        e = model_cfg.n_embd
+        dt = jnp.dtype(model_cfg.dtype)
+        stage = jax.lax.axis_index("pipe")
+        n_ticks = 2 * (m + n_stages - 1)
+        perm_bwd = [(i, i - 1) for i in range(1, n_stages)]
+
+        def stage_apply(params, x, tok, tgt):
+            params = gather_nonblock(params)
+            x0 = jax.lax.cond(
+                stage == 0,
+                lambda: model.embed(params, tok, model_cfg),
+                lambda: x,
+            )
+            y = model.run_blocks(
+                params["blocks"], x0, model_cfg,
+                block_transform=gather_block,
+            )
+            loss = jax.lax.cond(
+                stage == n_stages - 1,
+                lambda: cross_entropy_loss(
+                    model.head(params, y, model_cfg), tgt
+                ),
+                lambda: _vary(jnp.zeros((), jnp.float32)),
+            )
+            return y, loss
+
+        def mb_slices(idx):
+            tok = jax.lax.dynamic_index_in_dim(
+                inputs_mb, idx, 0, keepdims=False
+            )
+            tgt = jax.lax.dynamic_index_in_dim(
+                targets_mb, idx, 0, keepdims=False
+            )
+            return tok, tgt
+
+        zero_act = _vary(jnp.zeros((b, t, e), dt))
+        zero_grads = jax.tree.map(
+            lambda p: pvary_missing(
+                jnp.zeros(p.shape, jnp.float32),
+                tuple(getattr(jax.typeof(p), "vma", frozenset())),
+            ),
+            vparams,
+        )
+
+        def tick(carry, tk):
+            fwd_in, bwd_in, stash, gacc, lacc = carry
+
+            # ---- forward op: F(s, m_f) at tk == 2*m_f + s ----------------
+            mf2 = tk - stage
+            is_f = (mf2 >= 0) & (mf2 % 2 == 0) & (mf2 < 2 * m)
+            m_f = jnp.clip(mf2 // 2, 0, m - 1)
+            tok_f, tgt_f = mb_slices(m_f)
+
+            def do_f(stash):
+                slot = jnp.mod(m_f, n_stages)
+                stash = jax.lax.dynamic_update_slice_in_dim(
+                    stash, fwd_in[None], slot, axis=0
+                )
+                y, _ = stage_apply(vparams, fwd_in, tok_f, tgt_f)
+                return y, stash
+
+            y_out, stash = jax.lax.cond(
+                is_f, do_f, lambda st: (zero_act, st), stash
+            )
+
+            # ---- backward op: B(s, m_b) at tk == 2*m_b + 2S-1 - s --------
+            mb2 = tk - (2 * n_stages - 1 - stage)
+            is_b = (mb2 >= 0) & (mb2 % 2 == 0) & (mb2 < 2 * m)
+            m_b = jnp.clip(mb2 // 2, 0, m - 1)
+            tok_b, tgt_b = mb_slices(m_b)
+
+            def do_b(operands):
+                bwd_in, stash = operands
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    stash, jnp.mod(m_b, n_stages), 0, keepdims=False
+                )
+                (y_p, loss_p), vjp = jax.vjp(
+                    lambda p, x: stage_apply(p, x, tok_b, tgt_b),
+                    vparams, x_saved,
+                )
+                # Seed: the last stage differentiates its own mean-scaled
+                # loss; other stages chain the arriving cotangent into y.
+                dy = jnp.where(stage == n_stages - 1, 0.0, 1.0) * bwd_in
+                dl = jnp.where(
+                    stage == n_stages - 1, 1.0 / m, 0.0
+                ).astype(jnp.float32)
+                dp, dx = vjp((dy.astype(y_p.dtype), _vary(dl)))
+                return dp, dx.astype(dt), loss_p
+
+            dp, dx_out, loss_p = jax.lax.cond(
+                is_b,
+                do_b,
+                lambda ops: (zero_grads, zero_act,
+                             _vary(jnp.zeros((), jnp.float32))),
+                (bwd_in, stash),
+            )
+            gacc = jax.tree.map(jnp.add, gacc, dp)
+            lacc = lacc + loss_p
+
+            # ---- neighbour exchange (consumed exactly one tick later) ----
+            fwd_next = jax.lax.ppermute(y_out, "pipe", perm)
+            bwd_next = jax.lax.ppermute(dx_out, "pipe", perm_bwd)
+            return (fwd_next, bwd_next, stash, gacc, lacc), None
+
+        stash0 = _vary(jnp.zeros((n_stages, b, t, e), dt))
+        carry0 = (
+            zero_act, zero_act, stash0, zero_grads,
+            _vary(jnp.zeros((), jnp.float32)),
+        )
+        carry_out, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+        _, _, _, gacc, lacc = carry_out
+        loss = jax.lax.psum(lacc, "pipe") / m
+        return loss, gacc
+
     def step_impl(state: TrainState, batch: dict, dropout_key: jax.Array):
         del dropout_key  # deterministic-only path
         vparams = jax.tree.map(_vary, state.params)
-        loss, grads = grad_fn(vparams, batch["inputs"], batch["targets"])
+        if schedule == "1f1b":
+            loss, grads = loss_and_grads_1f1b(
+                vparams, batch["inputs"], batch["targets"]
+            )
+        else:
+            loss, grads = grad_fn(
+                vparams, batch["inputs"], batch["targets"]
+            )
 
         # Replicated leaves hold disjoint per-stage partials — psum over
         # pipe reconstructs the full grad; pipe-sharded block leaves are

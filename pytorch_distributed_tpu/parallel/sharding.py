@@ -11,6 +11,9 @@ Capability twin of the reference's three FSDP sharding strategies
   shard_grad_op  (ZeRO-2): params replicated; optimizer state sharded.
                  The weight update runs on shards and re-gathers params —
                  reduce_scatter(grads) + sharded update + all_gather(params).
+  shard_opt      (ZeRO-1, a level torch FSDP lacks): optimizer state
+                 sharded only; grads all-reduce replicated, each shard
+                 updates its slice, updated params re-gathered.
   no_shard       (DDP): everything replicated; gradient psum only.
 
 Sharding is expressed per-leaf as a NamedSharding over the mesh's "fsdp"
@@ -196,7 +199,9 @@ def opt_state_partition_specs(opt_state, params_specs, mesh_cfg: MeshConfig):
     fsdp-replicated. Tensor-parallel dims always mirror the params (moments
     live where their params live). Scalar leaves (step counts) replicate."""
     del params_specs  # moments share param shapes; specs derive from shapes
-    shard_fsdp = mesh_cfg.strategy in ("full_shard", "shard_grad_op")
+    shard_fsdp = mesh_cfg.strategy in (
+        "full_shard", "shard_grad_op", "shard_opt"
+    )
 
     def leaf_spec(path, leaf):
         shape = tuple(getattr(leaf, "shape", ()))

@@ -103,6 +103,7 @@ class DistributedTrainer(Trainer):
             self.train_step = make_pipeline_train_step(
                 self.model, self.model_cfg, self.tx, self.mesh,
                 self.mesh_cfg, state, self.train_cfg,
+                schedule=self.mesh_cfg.pipe_schedule,
             )
             return state
         state, _ = shard_train_state(state, self.mesh, self.mesh_cfg)

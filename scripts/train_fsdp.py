@@ -35,9 +35,12 @@ from _common import (  # noqa: E402
 _STRATEGY_MAP = {
     "FULL_SHARD": "full_shard",
     "SHARD_GRAD_OP": "shard_grad_op",
+    # ZeRO-1 (no torch-FSDP equivalent): optimizer state sharded only.
+    "SHARD_OPT": "shard_opt",
     "NO_SHARD": "no_shard",
     "full_shard": "full_shard",
     "shard_grad_op": "shard_grad_op",
+    "shard_opt": "shard_opt",
     "no_shard": "no_shard",
 }
 

@@ -66,7 +66,7 @@ def main() -> int:
     )
     p.add_argument(
         "--strategy", default="full_shard",
-        choices=["full_shard", "shard_grad_op", "no_shard"],
+        choices=["full_shard", "shard_grad_op", "shard_opt", "no_shard"],
     )
     p.add_argument(
         "--path", default="auto", choices=["auto", "explicit", "pipeline"]
@@ -78,6 +78,13 @@ def main() -> int:
              "EXPLICIT path (--path explicit): ring (ppermute KV ring) or "
              "ulysses (head/seq all-to-all; needs the axis to divide the "
              "head counts)",
+    )
+    p.add_argument(
+        "--pipe-schedule", default="gpipe", choices=["gpipe", "1f1b"],
+        help="pipeline schedule (--path pipeline): gpipe (backward by AD "
+             "transposition) or 1f1b (hand-scheduled PipeDream-flush; "
+             "activation stash bounded at pipe slots instead of the "
+             "microbatch count)",
     )
     p.add_argument(
         "--no-dropout", action="store_true",
@@ -113,7 +120,9 @@ def main() -> int:
             f"mesh {axes} covers {math.prod(axes.values())} devices, "
             f"but {n_devices} are visible"
         )
-    mesh_cfg = MeshConfig(**axes, strategy=args.strategy)
+    mesh_cfg = MeshConfig(
+        **axes, strategy=args.strategy, pipe_schedule=args.pipe_schedule
+    )
     mesh = make_mesh(mesh_cfg)
 
     model_cfg = build_model_cfg(args)

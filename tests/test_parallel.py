@@ -74,6 +74,9 @@ STRATEGIES = [
     ("full_shard", 2, 4, 1),
     ("shard_grad_op", 1, 8, 1),
     ("shard_grad_op", 2, 4, 1),
+    # ZeRO-1: optimizer state sharded only.
+    ("shard_opt", 1, 8, 1),
+    ("shard_opt", 2, 4, 1),
     # Context parallelism (ring attention over the seq axis), alone and
     # composed with DP and FSDP.
     ("no_shard", 1, 1, 8),
@@ -356,9 +359,12 @@ def test_full_shard_actually_shards_state(setup, eight_devices):
         assert not spec or spec[0] is None
 
 
-def test_shard_grad_op_replicates_params_shards_opt(setup, eight_devices):
+@pytest.mark.parametrize("strategy", ["shard_grad_op", "shard_opt"])
+def test_shard_grad_op_replicates_params_shards_opt(
+    setup, eight_devices, strategy
+):
     cfg, tx, model = setup["cfg"], setup["tx"], setup["model"]
-    mcfg = MeshConfig(fsdp=8, strategy="shard_grad_op")
+    mcfg = MeshConfig(fsdp=8, strategy=strategy)
     mesh = make_mesh(mcfg)
     state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
     state, _ = shard_train_state(state, mesh, mcfg)
@@ -390,6 +396,7 @@ CLIP_CONFIGS = [
     ("full_shard", 1, 8),
     ("full_shard", 2, 4),
     ("shard_grad_op", 1, 8),
+    ("shard_opt", 1, 8),
 ]
 
 

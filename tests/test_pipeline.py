@@ -137,3 +137,49 @@ def test_pipeline_fsdp_actually_shards_state(setup):
     shard = leaf.addressable_shards[0].data
     assert shard.shape[0] == cfg.n_layer // 2  # pipe slice of the stack
     assert np.prod(shard.shape) == np.prod(leaf.shape) // 4  # + fsdp dim
+
+
+@pytest.mark.parametrize(
+    "pipe,data,fsdp,strategy",
+    [
+        (2, 1, 1, "no_shard"),
+        (4, 2, 1, "no_shard"),
+        (2, 2, 2, "full_shard"),  # 1F1B x in-stage ZeRO-3
+    ],
+)
+def test_1f1b_matches_single_device(setup, pipe, data, fsdp, strategy):
+    """The hand-scheduled 1F1B schedule must produce the same numbers as
+    the single-device accumulated step (and therefore as GPipe): the
+    schedule changes WHEN each microbatch's backward runs, not the math."""
+    cfg, model, tx = setup["cfg"], setup["model"], setup["tx"]
+    mcfg = MeshConfig(
+        pipe=pipe, data=data, fsdp=fsdp, strategy=strategy,
+        pipe_schedule="1f1b",
+    )
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(
+        model, cfg, tx, mesh, mcfg, state, schedule="1f1b"
+    )
+    new_state, metrics = step(state, setup["batch"], jax.random.key(0))
+    assert float(metrics["loss"]) == pytest.approx(setup["ref_loss"], abs=1e-5)
+    assert float(metrics["grad_norm"]) == pytest.approx(
+        setup["ref_gnorm"], abs=1e-4
+    )
+    for a, b in zip(
+        jax.tree.leaves(setup["ref_params"]),
+        jax.tree.leaves(jax.device_get(new_state.params)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_pipeline_rejects_unknown_schedule(setup):
+    cfg, model, tx = setup["cfg"], setup["model"], setup["tx"]
+    mcfg = MeshConfig(pipe=2, strategy="no_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    with pytest.raises(ValueError, match="schedule"):
+        make_pipeline_train_step(
+            model, cfg, tx, mesh, mcfg, state, schedule="zigzag"
+        )
