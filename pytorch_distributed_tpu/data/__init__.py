@@ -11,3 +11,9 @@ from pytorch_distributed_tpu.data.distributed_loader import (  # noqa: F401
     DistributedTokenShardLoader,
 )
 from pytorch_distributed_tpu.data.synthetic import make_synthetic_shards  # noqa: F401
+from pytorch_distributed_tpu.data.text import (  # noqa: F401
+    BYTE_VOCAB_SIZE,
+    decode_bytes,
+    encode_bytes,
+    tokenize_files,
+)

@@ -1,0 +1,96 @@
+"""Raw-text -> pretokenized `.bin` shards (byte-level, zero dependencies).
+
+The reference's data story starts from DOWNLOADED pretokenized fineweb10B
+shards (reference data/data_loader.py:9-65); users with their own corpora
+have no path in. This module closes that gap without any network or
+tokenizer assets: text is encoded byte-level (UTF-8 bytes ARE the tokens,
+vocab 256 + one document separator), written in the same kjj0 `.bin`
+format (data/bin_format.py), so every loader — sequential, distributed,
+native C++ — consumes it unmodified. Train with
+``ModelConfig(vocab_size=257)``.
+
+For subword tokenization, pass any callable ``encode(text) -> list[int]``
+(e.g. a HuggingFace tokenizer's) to ``tokenize_files``; byte-level is only
+the dependency-free default.
+
+CLI: ``python scripts/tokenize_text.py corpus/*.txt -o .cache/data/mine``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Callable, Iterable, Sequence
+
+import numpy as np
+
+from pytorch_distributed_tpu.data import bin_format
+
+# Byte-level vocabulary: 0..255 raw bytes, 256 document separator.
+BYTE_VOCAB_SIZE = 257
+DOC_SEPARATOR = 256
+
+
+def encode_bytes(text: str) -> list[int]:
+    """UTF-8 byte-level encoding — every string round-trips losslessly."""
+    return list(text.encode("utf-8"))
+
+
+def decode_bytes(tokens: Iterable[int]) -> str:
+    """Inverse of encode_bytes; separator tokens become newlines."""
+    out = bytearray()
+    for t in tokens:
+        if t == DOC_SEPARATOR:
+            out += b"\n"
+        elif 0 <= t < 256:
+            out.append(t)
+    return out.decode("utf-8", errors="replace")
+
+
+def tokenize_files(
+    paths: Sequence[str | Path],
+    out_dir: str | Path,
+    *,
+    shard_tokens: int = 10_000_000,
+    encode: Callable[[str], list[int]] = encode_bytes,
+    separator: int | None = DOC_SEPARATOR,
+    prefix: str = "text_train",
+) -> list[Path]:
+    """Tokenize text files into fixed-size `.bin` shards.
+
+    Each input file is one document; ``separator`` (if not None) is
+    appended after each so the model sees document boundaries. Returns the
+    shard paths (``{prefix}_{idx:06d}.bin``), ready for TokenShardLoader.
+    """
+    if not paths:
+        raise ValueError("tokenize_files needs at least one input path")
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    shards: list[Path] = []
+    buf: list[int] = []
+
+    def flush() -> None:
+        if not buf:
+            return
+        path = out_dir / f"{prefix}_{len(shards):06d}.bin"
+        bin_format.write_shard(path, np.asarray(buf, dtype=np.uint16))
+        shards.append(path)
+        buf.clear()
+
+    for p in paths:
+        toks = encode(Path(p).read_text(encoding="utf-8"))
+        if separator is not None:
+            toks = list(toks) + [separator]
+        for t in toks:
+            if not (0 <= t < 2**16):
+                raise ValueError(
+                    f"token {t} out of uint16 range (the .bin format "
+                    "stores uint16; vocab must be < 65536)"
+                )
+        buf.extend(toks)
+        while len(buf) >= shard_tokens:
+            head, rest = buf[:shard_tokens], buf[shard_tokens:]
+            buf[:] = head
+            flush()
+            buf[:] = rest
+    flush()
+    return shards

@@ -156,12 +156,24 @@ def forward(
     return logits, {"k": ck, "v": cv}
 
 
-def _sample(logits, temperature, key, top_k=None):
+def _sample(logits, temperature, key, top_k=None, top_p=None):
     """[B, V] -> [B] next tokens. temperature 0 = greedy; top_k restricts
-    sampling to the k highest-probability tokens."""
+    sampling to the k highest-probability tokens; top_p (nucleus) restricts
+    it to the smallest set whose probability mass reaches p."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
+    if top_p is not None:
+        # Sort descending; keep tokens whose CUMULATIVE mass before them is
+        # < p (the argmax token is always kept), mask out the tail.
+        vals, idx = jax.lax.top_k(logits, logits.shape[-1])  # sorted desc
+        probs = jax.nn.softmax(vals, axis=-1)
+        cum_before = jnp.cumsum(probs, axis=-1) - probs
+        masked = jnp.where(cum_before < top_p, vals, -jnp.inf)
+        choice = jax.random.categorical(key, masked, axis=-1)  # [B]
+        return jnp.take_along_axis(
+            idx, choice[:, None], axis=-1
+        )[:, 0].astype(jnp.int32)
     if top_k is not None:
         vals, idx = jax.lax.top_k(logits, top_k)  # [B, k]
         choice = jax.random.categorical(key, vals, axis=-1)  # [B]
@@ -174,7 +186,7 @@ def _sample(logits, temperature, key, top_k=None):
 @partial(
     jax.jit,
     static_argnames=(
-        "cfg", "max_new_tokens", "temperature", "max_len", "top_k"
+        "cfg", "max_new_tokens", "temperature", "max_len", "top_k", "top_p"
     ),
 )
 def generate(
@@ -187,6 +199,7 @@ def generate(
     key: jax.Array | None = None,
     max_len: int | None = None,
     top_k: int | None = None,
+    top_p: float | None = None,
 ) -> jax.Array:
     """Autoregressive generation: returns [B, Tp + max_new_tokens].
 
@@ -209,7 +222,7 @@ def generate(
 
     cache = init_cache(cfg, b, max_len)
     logits, cache = forward(params, prompt, cfg, cache, 0)
-    next_tok = _sample(logits[:, -1], temperature, key, top_k)
+    next_tok = _sample(logits[:, -1], temperature, key, top_k, top_p)
 
     out = jnp.zeros((b, total), jnp.int32)
     out = jax.lax.dynamic_update_slice(out, prompt.astype(jnp.int32), (0, 0))
@@ -220,7 +233,8 @@ def generate(
         pos = tp + i
         logits, cache = forward(params, tok[:, None], cfg, cache, pos)
         nxt = _sample(
-            logits[:, -1], temperature, jax.random.fold_in(key, i), top_k
+            logits[:, -1], temperature, jax.random.fold_in(key, i), top_k,
+            top_p,
         )
         out = out.at[:, pos + 1].set(nxt)
         return out, cache, nxt

@@ -222,6 +222,36 @@ def read_metadata(directory: str | Path) -> dict:
     return meta.get("metadata", {})
 
 
+def prune_checkpoints(checkpoint_root: str | Path, keep: int) -> list[str]:
+    """Delete all but the newest ``keep`` ``checkpoint_step_{n}`` dirs.
+
+    Process-0 only (other processes no-op); call AFTER a successful save —
+    the collective save's own barrier guarantees no peer is still writing
+    the surviving checkpoints, and deleted ones are strictly older than
+    the one just committed. Returns the removed paths.
+    """
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return []
+    root = Path(checkpoint_root)
+    if not root.exists():
+        return []
+    steps: list[tuple[int, Path]] = []
+    for child in root.iterdir():
+        if child.is_dir() and child.name.startswith("checkpoint_step_"):
+            try:
+                steps.append((int(child.name.rsplit("_", 1)[1]), child))
+            except ValueError:
+                continue
+    steps.sort(reverse=True)
+    removed = []
+    for _, path in steps[keep:]:
+        shutil.rmtree(path, ignore_errors=True)
+        removed.append(str(path))
+    return removed
+
+
 def latest_checkpoint(checkpoint_root: str | Path) -> str | None:
     """Find the newest ``checkpoint_step_{n}`` dir (reference naming
     trainer.py:100-106)."""

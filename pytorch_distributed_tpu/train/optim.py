@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import math
 
+import jax
 import optax
 
 from pytorch_distributed_tpu.config import TrainConfig
@@ -61,12 +62,34 @@ def make_optimizer(
     the psum'd global norm themselves — ``optax.clip_by_global_norm`` seen
     per-shard computes a shard-local norm, a different clip scale per
     shard."""
+    if cfg.decay_exclude_1d:
+        # Modern convention: no weight decay on norm scales and biases.
+        # Matched by NAME (leaf key "bias"/"scale") plus an effective-rank
+        # rule that accounts for layer-STACKED block leaves ([L, ...] —
+        # an ln scale is [L, E], rank 2, but logically 1-D per layer).
+        # Default OFF: the reference decays everything (torch AdamW
+        # default, train_baseline.py:61).
+        def decay_mask(params):
+            def rule(path, p):
+                keys = [getattr(k, "key", None) for k in path]
+                if keys and keys[-1] in ("bias", "scale"):
+                    return False
+                eff_ndim = getattr(p, "ndim", 0) - (
+                    1 if "blocks" in keys else 0
+                )
+                return eff_ndim >= 2
+
+            return jax.tree_util.tree_map_with_path(rule, params)
+
+        decay = optax.add_decayed_weights(cfg.weight_decay, mask=decay_mask)
+    else:
+        decay = optax.add_decayed_weights(cfg.weight_decay)
     steps = [
         optax.clip_by_global_norm(cfg.grad_clip_norm)
         if (with_clip and cfg.grad_clip_norm is not None)
         else optax.identity(),
         optax.scale_by_adam(b1=cfg.beta1, b2=cfg.beta2, eps=cfg.eps),
-        optax.add_decayed_weights(cfg.weight_decay),
+        decay,
         optax.scale_by_learning_rate(make_schedule(cfg)),
     ]
     return optax.chain(*steps)

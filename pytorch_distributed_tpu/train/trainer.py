@@ -266,11 +266,18 @@ class Trainer:
             # continue the token stream instead of repeating it (the
             # reference's loader always restarts at shard 0).
             metadata["loader_state"] = loader.state_dict()
-        return ckpt_lib.save_checkpoint(
+        path = ckpt_lib.save_checkpoint(
             self.checkpoint_path(step),
             state,
             metadata=metadata,
         )
+        if self.train_cfg.keep_checkpoints is not None:
+            # After the (barriered) save: only strictly-older dirs go.
+            ckpt_lib.prune_checkpoints(
+                self.train_cfg.checkpoint_dir,
+                self.train_cfg.keep_checkpoints,
+            )
+        return path
 
     def load_checkpoint(self, path: str | Path, state: TrainState) -> TrainState:
         return ckpt_lib.load_checkpoint(path, state)

@@ -36,7 +36,11 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
                    help="model preset (gpt2, gpt2-large, gpt2-1p3b, "
                         "llama3-1b, ... or 'tiny')")
     p.add_argument("--data", default="synthetic",
-                   choices=["synthetic", "fineweb"])
+                   choices=["synthetic", "fineweb", "local"],
+                   help="synthetic (zero-egress generated shards), fineweb "
+                        "(downloads like the reference), or local (train "
+                        "on every *.bin already in --data-dir — e.g. from "
+                        "scripts/tokenize_text.py)")
     p.add_argument("--data-dir", default=".cache/data")
     p.add_argument("--num-train-files", type=int, default=10)
     p.add_argument("--global-batch-size", type=int, default=32)
@@ -49,6 +53,9 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
     p.add_argument("--log-every", type=int, default=10)
     p.add_argument("--save-every", type=int, default=None)
     p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--keep-checkpoints", type=int, default=None,
+                   help="retain only the newest N checkpoints "
+                        "(default: keep all)")
     p.add_argument("--metrics-out", default=None,
                    help="append logged metrics as JSON lines to this file")
     p.add_argument("--save-on-preemption", action="store_true",
@@ -144,6 +151,7 @@ def build_train_cfg(args, *, data_parallel_size: int = 1):
         log_every_n_steps=args.log_every,
         save_every_n_steps=args.save_every,
         checkpoint_dir=args.checkpoint_dir,
+        keep_checkpoints=args.keep_checkpoints,
         metrics_path=args.metrics_out,
         save_on_preemption=args.save_on_preemption,
     )
@@ -152,6 +160,16 @@ def build_train_cfg(args, *, data_parallel_size: int = 1):
 
 
 def shard_paths(args, vocab_size: int) -> list[str]:
+    if args.data == "local":
+        import glob
+
+        paths = sorted(glob.glob(os.path.join(args.data_dir, "*.bin")))
+        if not paths:
+            raise SystemExit(
+                f"--data local: no *.bin shards in {args.data_dir!r} "
+                "(produce some with scripts/tokenize_text.py)"
+            )
+        return paths
     if args.data == "fineweb":
         from pytorch_distributed_tpu.data.download import (
             download_fineweb10B_files,
@@ -174,8 +192,12 @@ def shard_paths(args, vocab_size: int) -> list[str]:
 
 def val_shard_paths(args, vocab_size: int) -> list[str]:
     """Validation data: the fineweb val shard (reference
-    data_loader.py:28-41 downloads it; nothing there ever reads it), or a
-    held-out synthetic shard from a disjoint seed."""
+    data_loader.py:28-41 downloads it; nothing there ever reads it), a
+    held-out synthetic shard from a disjoint seed, or — for --data local —
+    the LAST local shard (hold it out of training yourself if you need a
+    clean split)."""
+    if args.data == "local":
+        return [shard_paths(args, vocab_size)[-1]]
     if args.data == "fineweb":
         from pathlib import Path
 

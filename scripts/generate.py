@@ -48,6 +48,9 @@ def main() -> int:
     ap.add_argument("--max-new-tokens", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=None)
+    ap.add_argument("--top-p", type=float, default=None,
+                    help="nucleus sampling: smallest token set whose "
+                         "probability mass reaches p (overrides --top-k)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -112,6 +115,7 @@ def main() -> int:
         temperature=args.temperature,
         key=jax.random.key(args.seed) if args.temperature > 0 else None,
         top_k=args.top_k,
+        top_p=args.top_p,
     )
     out = np.asarray(jax.device_get(out))[0]
     if tok is not None:

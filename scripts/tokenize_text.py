@@ -1,0 +1,67 @@
+"""Tokenize raw text files into kjj0 `.bin` shards (byte-level by default).
+
+Zero-network path from your own corpus to the training pipeline:
+
+  python scripts/tokenize_text.py corpus/*.txt -o .cache/data/mine
+  python scripts/train_baseline.py --preset tiny --data local \\
+      --data-dir .cache/data/mine   # trains on every *.bin in the dir
+
+Byte-level vocab is 257 (bytes + doc separator): train with a model config
+whose vocab_size >= 257. Use --hf-tokenizer NAME to encode with a
+HuggingFace tokenizer instead (requires its assets locally/cached).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from _common import *  # noqa: F401,F403 — sys.path bootstrap
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("inputs", nargs="+", help="text files (one doc each)")
+    ap.add_argument("-o", "--out-dir", required=True)
+    ap.add_argument("--shard-tokens", type=int, default=10_000_000)
+    ap.add_argument(
+        "--hf-tokenizer", default=None,
+        help="HuggingFace tokenizer name for subword encoding "
+             "(default: dependency-free byte-level, vocab 257)",
+    )
+    args = ap.parse_args()
+
+    from pytorch_distributed_tpu.data.bin_format import total_tokens
+    from pytorch_distributed_tpu.data.text import (
+        BYTE_VOCAB_SIZE,
+        encode_bytes,
+        tokenize_files,
+    )
+
+    if args.hf_tokenizer:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(args.hf_tokenizer)
+        encode = lambda text: tok.encode(text)  # noqa: E731
+        vocab = tok.vocab_size
+        separator = tok.eos_token_id
+        if separator is None:
+            print(
+                f"WARNING: {args.hf_tokenizer!r} has no EOS token; "
+                "documents will be concatenated with NO separator"
+            )
+    else:
+        encode, vocab, separator = encode_bytes, BYTE_VOCAB_SIZE, 256
+
+    shards = tokenize_files(
+        args.inputs, args.out_dir, shard_tokens=args.shard_tokens,
+        encode=encode, separator=separator,
+    )
+    print(
+        f"wrote {len(shards)} shard(s), {total_tokens(shards):,} tokens, "
+        f"vocab {vocab} -> {args.out_dir}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

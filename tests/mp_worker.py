@@ -202,6 +202,28 @@ def main() -> None:
     assert int(jax.device_get(state3.step)) == stop_step + 1
     results["resumed_loss"] = hist3[-1]["loss"] if hist3 else None
 
+    # -- I: tensor parallelism across REAL processes ------------------------
+    # The "tensor" axis spans the process boundary: every per-layer psum of
+    # the explicit Megatron path crosses gloo. Batch is replicated under
+    # pure TP, so each process feeds the SAME rows (rank-0/world-1 loader)
+    # and the losses must equal the single-process run bit-for-bit.
+    from pytorch_distributed_tpu.data.loader import TokenShardLoader
+
+    tcfg_tp = TrainConfig(
+        global_batch_size=2 * B_local, micro_batch_size=2 * B_local,
+        num_steps=2, learning_rate=1e-3, seed=42, log_every_n_steps=1,
+    )
+    mcfg_tp = MeshConfig(tensor=n, strategy="no_shard")
+    mesh_tp = make_mesh(mcfg_tp)
+    trainer_tp = DistributedTrainer(
+        model, cfg, tcfg_tp, mesh_tp, mcfg_tp, path="explicit"
+    )
+    state_tp, hist_tp = trainer_tp.train(
+        TokenShardLoader([shard], 2 * B_local, T)
+    )
+    assert int(jax.device_get(state_tp.step)) == 2
+    results["tp_losses"] = [h["loss"] for h in hist_tp]
+
     (workdir / f"result_p{pid}.json").write_text(json.dumps(results))
     print(f"worker {pid}: all scenarios passed", flush=True)
 

@@ -138,3 +138,23 @@ def test_generate_zero_new_tokens_returns_prompt():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
     with pytest.raises(ValueError, match=">= 0"):
         decode.generate(params, prompt, cfg, -1)
+
+
+def test_generate_top_p_one_keeps_full_support_and_tiny_p_is_greedy():
+    """top_p->0 must reduce to greedy (only the argmax survives the
+    nucleus); top_p=1.0 runs the full-support sampling path."""
+    cfg = _cfg("gpt2")
+    params = get_model(cfg).init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, cfg.vocab_size)
+    greedy = decode.generate(params, prompt, cfg, 5)
+    tiny_p = decode.generate(
+        params, prompt, cfg, 5, temperature=1.0, key=jax.random.key(9),
+        top_p=1e-9,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(tiny_p))
+    full_p = decode.generate(
+        params, prompt, cfg, 5, temperature=1.0, key=jax.random.key(9),
+        top_p=1.0,
+    )
+    assert full_p.shape == (2, 9)
+    assert bool((np.asarray(full_p) < cfg.vocab_size).all())

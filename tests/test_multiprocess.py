@@ -88,6 +88,36 @@ def test_workers_agree(mp_run):
     r0, r1 = mp_run["results"]
     np.testing.assert_allclose(r0["losses"], r1["losses"], atol=1e-6)
     assert r0["stop_step"] == r1["stop_step"] > 0
+    np.testing.assert_allclose(r0["tp_losses"], r1["tp_losses"], atol=1e-6)
+
+
+def test_cross_process_tensor_parallel_matches_reference(mp_run):
+    """Explicit Megatron TP with the tensor axis spanning a REAL process
+    boundary (every per-layer psum crosses gloo) reproduces the
+    single-process step on the same batch."""
+    import jax
+
+    from pytorch_distributed_tpu.config import ModelConfig, TrainConfig
+    from pytorch_distributed_tpu.data.loader import TokenShardLoader
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.train.trainer import Trainer
+
+    cfg = ModelConfig(
+        vocab_size=128, n_ctx=8, n_embd=32, n_layer=2, n_head=4,
+        dtype="float32", embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+    )
+    tcfg = TrainConfig(
+        global_batch_size=8, micro_batch_size=8, num_steps=2,
+        learning_rate=1e-3, seed=42, log_every_n_steps=1,
+    )
+    trainer = Trainer(get_model(cfg), cfg, tcfg)
+    _, history = trainer.train(
+        TokenShardLoader([mp_run["workdir"] / "shard.bin"], 8, 8)
+    )
+    ref = [h["loss"] for h in history]
+    np.testing.assert_allclose(
+        mp_run["results"][0]["tp_losses"], ref, atol=2e-5
+    )
 
 
 def test_matches_single_process_reference(mp_run):
