@@ -246,6 +246,15 @@ class TrainConfig:
     # modern pretraining convention. Default OFF: the reference decays
     # every param (torch AdamW default, train_baseline.py:61).
     decay_exclude_1d: bool = False
+    # Gradient-accumulation buffer dtype (A > 1 only; honoured by the
+    # single-device, pjit and explicit paths — the pipeline path's
+    # accumulation dtype follows AD). "float32" (default) is the safe
+    # convention; "bfloat16" halves the accumulator HBM — the buffer that
+    # decides whether a 774M model accumulates on one 16 GB chip at all
+    # (see scripts/_common.py --param-dtype help). bf16 accumulation
+    # loses ~8 mantissa bits across the A partial sums; acceptable at
+    # small A, measure before using at large A.
+    accum_dtype: str = "float32"
     # Cosine anneal to min_lr_ratio * learning_rate over num_steps
     # (reference train_baseline.py:62-64: CosineAnnealingLR eta_min=0.1*lr).
     lr_schedule: str = "cosine"
@@ -261,12 +270,23 @@ class TrainConfig:
     # successful save. Validated at construction (grad_accum_steps-style
     # late failures would kill a run at its first save).
     keep_checkpoints: int | None = None
+    # Overlap checkpoint writes with training (orbax AsyncCheckpointer):
+    # the device arrays are snapshotted at the save step, serialization
+    # runs in background threads, and the checkpoint becomes visible at
+    # the next save / end of training (train/checkpoint.py
+    # save_checkpoint_async). Off = the reference's blocking-save model.
+    async_checkpoint: bool = False
 
     def __post_init__(self) -> None:
         if self.keep_checkpoints is not None and self.keep_checkpoints < 1:
             raise ValueError(
                 f"keep_checkpoints must be >= 1 or None, got "
                 f"{self.keep_checkpoints}"
+            )
+        if self.accum_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"unknown accum_dtype: {self.accum_dtype!r} "
+                "(implemented: float32, bfloat16)"
             )
     # Optional JSONL metrics sink: every logged window (step/loss/lr/
     # elapsed) is appended as one JSON object — machine-readable run

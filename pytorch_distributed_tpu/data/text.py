@@ -80,13 +80,17 @@ def tokenize_files(
         toks = encode(Path(p).read_text(encoding="utf-8"))
         if separator is not None:
             toks = list(toks) + [separator]
-        for t in toks:
-            if not (0 <= t < 2**16):
-                raise ValueError(
-                    f"token {t} out of uint16 range (the .bin format "
-                    "stores uint16; vocab must be < 65536)"
-                )
-        buf.extend(toks)
+        # Vectorised range check: np.uint16 conversion would WRAP silently
+        # (a per-token Python loop here is interpreter-bound on real
+        # corpora).
+        arr = np.asarray(toks, dtype=np.int64)
+        if arr.size and (int(arr.min()) < 0 or int(arr.max()) >= 2**16):
+            bad = int(arr[(arr < 0) | (arr >= 2**16)][0])
+            raise ValueError(
+                f"token {bad} out of uint16 range (the .bin format "
+                "stores uint16; vocab must be < 65536)"
+            )
+        buf.extend(arr.tolist())
         while len(buf) >= shard_tokens:
             head, rest = buf[:shard_tokens], buf[shard_tokens:]
             buf[:] = head

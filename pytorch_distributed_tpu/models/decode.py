@@ -159,28 +159,26 @@ def forward(
 def _sample(logits, temperature, key, top_k=None, top_p=None):
     """[B, V] -> [B] next tokens. temperature 0 = greedy; top_k restricts
     sampling to the k highest-probability tokens; top_p (nucleus) restricts
-    it to the smallest set whose probability mass reaches p."""
+    it to the smallest set whose probability mass reaches p. Given BOTH,
+    top-k applies first and the nucleus is taken within it (HF semantics).
+    """
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
+    if top_k is None and top_p is None:
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    k = top_k if top_k is not None else logits.shape[-1]
+    vals, idx = jax.lax.top_k(logits, k)  # [B, k], sorted desc
     if top_p is not None:
-        # Sort descending; keep tokens whose CUMULATIVE mass before them is
-        # < p (the argmax token is always kept), mask out the tail.
-        vals, idx = jax.lax.top_k(logits, logits.shape[-1])  # sorted desc
+        # Keep tokens whose CUMULATIVE mass (within the top-k support)
+        # before them is < p — the argmax token always survives.
         probs = jax.nn.softmax(vals, axis=-1)
         cum_before = jnp.cumsum(probs, axis=-1) - probs
-        masked = jnp.where(cum_before < top_p, vals, -jnp.inf)
-        choice = jax.random.categorical(key, masked, axis=-1)  # [B]
-        return jnp.take_along_axis(
-            idx, choice[:, None], axis=-1
-        )[:, 0].astype(jnp.int32)
-    if top_k is not None:
-        vals, idx = jax.lax.top_k(logits, top_k)  # [B, k]
-        choice = jax.random.categorical(key, vals, axis=-1)  # [B]
-        return jnp.take_along_axis(
-            idx, choice[:, None], axis=-1
-        )[:, 0].astype(jnp.int32)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+        vals = jnp.where(cum_before < top_p, vals, -jnp.inf)
+    choice = jax.random.categorical(key, vals, axis=-1)  # [B]
+    return jnp.take_along_axis(
+        idx, choice[:, None], axis=-1
+    )[:, 0].astype(jnp.int32)
 
 
 @partial(

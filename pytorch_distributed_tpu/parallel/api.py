@@ -49,6 +49,8 @@ def make_parallel_train_step(
     mesh: Mesh,
     mesh_cfg: MeshConfig,
     state: TrainState,
+    *,
+    accum_dtype: str = "float32",
 ):
     """Returns (train_step, batch_put) for a sharded TrainState.
 
@@ -77,6 +79,7 @@ def make_parallel_train_step(
         jit=False,
         logits_sharding=logits_sharding,
         grad_shardings=grad_shardings,
+        accum_dtype=accum_dtype,
     )
     batch_sharding = NamedSharding(mesh, batch_spec)
     metrics_sharding = NamedSharding(mesh, jax.sharding.PartitionSpec())

@@ -130,6 +130,7 @@ def make_explicit_train_step(
     state: TrainState,
     *,
     grad_clip_norm: float | None = None,
+    accum_dtype: str = "float32",
 ) -> Callable:
     """Build a jitted explicit-collective (state, batch, key) -> (state,
     metrics) step. State must already be placed per
@@ -293,12 +294,18 @@ def make_explicit_train_step(
             key = jax.random.fold_in(dropout_key, idx)
             loss, grads = grad_fn(vparams, inputs, targets, key)
             return (
-                jax.tree.map(jnp.add, grads_acc, grads),
+                # Accumulate in the buffer dtype (plain + would promote
+                # bf16 buffers back to f32).
+                jax.tree.map(
+                    lambda a, g: a + g.astype(a.dtype), grads_acc, grads
+                ),
                 loss_acc + loss,
             ), None
 
         zeros = jax.tree.map(
-            lambda p: _vary_like(jnp.zeros(p.shape, jnp.float32), p),
+            lambda p: _vary_like(
+                jnp.zeros(p.shape, jnp.dtype(accum_dtype)), p
+            ),
             state.params,
         )
         (grads, loss_sum), _ = jax.lax.scan(

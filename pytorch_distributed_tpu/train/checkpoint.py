@@ -125,6 +125,80 @@ def _sync(tag: str) -> None:
     multihost_utils.sync_global_devices(tag)
 
 
+# One in-flight async save at a time (module-level: the trainer treats
+# checkpointing as a global side effect, and two overlapping collective
+# saves would interleave their barriers).
+_PENDING_ASYNC: dict | None = None
+
+
+def finalize_async_save() -> str | None:
+    """Block until the in-flight async save (if any) commits, then perform
+    the tmp -> final swap + metadata write. Returns the finalized path.
+
+    MUST run before: starting another save, reading latest_checkpoint, or
+    process exit — Trainer calls it at those points automatically.
+    """
+    global _PENDING_ASYNC
+    if _PENDING_ASYNC is None:
+        return None
+    pend, _PENDING_ASYNC = _PENDING_ASYNC, None
+    pend["ckptr"].wait_until_finished()
+    pend["ckptr"].close()
+    directory: Path = pend["directory"]
+    tmp: Path = pend["tmp"]
+    if jax.process_index() == 0:
+        (tmp / "meta.json").write_text(
+            json.dumps(
+                {
+                    "format": "pdtpu-ckpt-orbax-v1",
+                    "metadata": pend["metadata"],
+                },
+                indent=1,
+            )
+        )
+        if directory.exists():
+            shutil.rmtree(directory)
+        os.replace(tmp, directory)
+    if jax.process_count() > 1:
+        _sync("pdtpu:ckpt:async-final")
+    return str(directory)
+
+
+def save_checkpoint_async(
+    directory: str | Path, state: Any, *, metadata: dict | None = None
+) -> str:
+    """Start an orbax save that overlaps training: device arrays are
+    snapshotted now, the serialization/write runs in background threads,
+    and the checkpoint becomes VISIBLE (tmp -> final swap, meta.json) only
+    at the next ``finalize_async_save()`` — which this function calls
+    first for any previous in-flight save, so at most one save is ever
+    pending and callers can fire-and-forget on a cadence.
+
+    Collective like the sync orbax path: EVERY process must call it.
+    """
+    import orbax.checkpoint as ocp
+
+    global _PENDING_ASYNC
+    finalize_async_save()
+    directory = Path(directory).resolve()
+    tmp = directory.parent / (".tmp_" + directory.name)
+    directory.parent.mkdir(parents=True, exist_ok=True)
+    if jax.process_index() == 0 and tmp.exists():
+        shutil.rmtree(tmp)
+    if jax.process_count() > 1:
+        # No process may start writing before the stale tmp is gone.
+        _sync("pdtpu:ckpt:async-clean")
+    ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+    ckptr.save(tmp / "tree", state)
+    _PENDING_ASYNC = {
+        "ckptr": ckptr,
+        "tmp": tmp,
+        "directory": directory,
+        "metadata": metadata or {},
+    }
+    return str(directory)
+
+
 def _save_orbax(
     directory: str | Path, state: Any, *, metadata: dict | None = None
 ) -> str:

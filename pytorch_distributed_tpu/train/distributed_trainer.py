@@ -118,11 +118,13 @@ class DistributedTrainer(Trainer):
                 make_optimizer(self.train_cfg, with_clip=False), self.mesh,
                 self.mesh_cfg, state,
                 grad_clip_norm=self.train_cfg.grad_clip_norm,
+                accum_dtype=self.train_cfg.accum_dtype,
             )
         else:
             self.train_step, _ = make_parallel_train_step(
                 self.model, self.model_cfg, self.tx, self.mesh,
                 self.mesh_cfg, state,
+                accum_dtype=self.train_cfg.accum_dtype,
             )
         return state
 

@@ -53,6 +53,7 @@ def make_train_step(
     jit: bool = True,
     logits_sharding=None,
     grad_shardings=None,
+    accum_dtype: str = "float32",
 ) -> Callable:
     """Build the jitted (state, batch, dropout_key) -> (state, metrics) step.
 
@@ -140,13 +141,18 @@ def make_train_step(
                 key = jax.random.fold_in(dropout_key, idx)
                 loss, grads = grad_fn(state.params, inputs, targets, key)
                 grads_acc = constrain_grads(
-                    jax.tree.map(jnp.add, grads_acc, grads)
+                    jax.tree.map(
+                        # Accumulate in the buffer's dtype (accum_dtype):
+                        # plain + would promote bf16 buffers back to f32.
+                        lambda a, g: a + g.astype(a.dtype), grads_acc, grads
+                    )
                 )
                 return (grads_acc, loss_acc + loss), None
 
             zeros = constrain_grads(
                 jax.tree.map(
-                    lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+                    lambda p: jnp.zeros(p.shape, jnp.dtype(accum_dtype)),
+                    state.params,
                 )
             )
             (grads, loss_sum), _ = jax.lax.scan(
@@ -236,7 +242,10 @@ class Trainer:
         self.train_step = (
             train_step
             if train_step is not None
-            else make_train_step(model, model_cfg, self.tx)
+            else make_train_step(
+                model, model_cfg, self.tx,
+                accum_dtype=train_cfg.accum_dtype,
+            )
         )
         self._put_batch = put_batch or (lambda b: b)
         self._dropout_root = domain_key(train_cfg.seed, "dropout")
@@ -266,6 +275,21 @@ class Trainer:
             # continue the token stream instead of repeating it (the
             # reference's loader always restarts at shard 0).
             metadata["loader_state"] = loader.state_dict()
+        if self.train_cfg.async_checkpoint:
+            # Fire-and-forget: the write overlaps subsequent steps; the
+            # previous in-flight save is finalized first (inside
+            # save_checkpoint_async), and train() finalizes the last one.
+            path = ckpt_lib.save_checkpoint_async(
+                self.checkpoint_path(step), state, metadata=metadata
+            )
+            if self.train_cfg.keep_checkpoints is not None:
+                # The PREVIOUS save just became visible — prune now so
+                # disk stays bounded during the run, not only at its end.
+                ckpt_lib.prune_checkpoints(
+                    self.train_cfg.checkpoint_dir,
+                    self.train_cfg.keep_checkpoints,
+                )
+            return path
         path = ckpt_lib.save_checkpoint(
             self.checkpoint_path(step),
             state,
@@ -285,6 +309,8 @@ class Trainer:
     def resume_latest(
         self, state: TrainState, *, loader: Any | None = None
     ) -> TrainState:
+        # An in-flight async save is invisible until finalized.
+        ckpt_lib.finalize_async_save()
         latest = ckpt_lib.latest_checkpoint(self.train_cfg.checkpoint_dir)
         if latest is None:
             return state
@@ -438,6 +464,13 @@ class Trainer:
 
                 for sig, prev in restore_handlers:
                     signal.signal(sig, prev)
+            if cfg.async_checkpoint:
+                # Exception-safe durability: an in-flight async save is
+                # only committed by finalize; losing it on a raised step
+                # or KeyboardInterrupt would silently discard a
+                # fully-written checkpoint (idempotent — the normal path
+                # below finalizes the preemption save too).
+                ckpt_lib.finalize_async_save()
         # NOT short-circuited on the local flag: every process must run the
         # same number of stop_requested() collectives, and must join the
         # collective save when ANY process was signalled. force_sync: this
@@ -449,6 +482,15 @@ class Trainer:
                 f"preemption signal received: checkpointing at step {step}"
             )
             self.save_checkpoint(state, loader=dataloader)
+
+        if cfg.async_checkpoint:
+            # Durability boundary: the last in-flight save must be
+            # committed and visible before train() returns.
+            ckpt_lib.finalize_async_save()
+            if cfg.keep_checkpoints is not None:
+                ckpt_lib.prune_checkpoints(
+                    cfg.checkpoint_dir, cfg.keep_checkpoints
+                )
 
         return state, history
 

@@ -56,6 +56,16 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
     p.add_argument("--keep-checkpoints", type=int, default=None,
                    help="retain only the newest N checkpoints "
                         "(default: keep all)")
+    p.add_argument("--async-checkpoint", action="store_true",
+                   help="overlap checkpoint writes with training (orbax "
+                        "async save; commits at the next save / end of "
+                        "run)")
+    p.add_argument("--accum-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="gradient-accumulation buffer dtype (A>1): bf16 "
+                        "halves the accumulator HBM — what lets gpt2-large "
+                        "accumulate on one 16 GB chip — at ~8 mantissa "
+                        "bits of accumulation precision")
     p.add_argument("--metrics-out", default=None,
                    help="append logged metrics as JSON lines to this file")
     p.add_argument("--save-on-preemption", action="store_true",
@@ -152,6 +162,8 @@ def build_train_cfg(args, *, data_parallel_size: int = 1):
         save_every_n_steps=args.save_every,
         checkpoint_dir=args.checkpoint_dir,
         keep_checkpoints=args.keep_checkpoints,
+        accum_dtype=args.accum_dtype,
+        async_checkpoint=args.async_checkpoint,
         metrics_path=args.metrics_out,
         save_on_preemption=args.save_on_preemption,
     )

@@ -158,3 +158,17 @@ def test_generate_top_p_one_keeps_full_support_and_tiny_p_is_greedy():
     )
     assert full_p.shape == (2, 9)
     assert bool((np.asarray(full_p) < cfg.vocab_size).all())
+
+
+def test_top_k_composes_with_top_p():
+    """top_k=1 + top_p=1.0 must equal greedy (k filters first, nucleus
+    within it — HF semantics), and combined filtering stays in-range."""
+    cfg = _cfg("gpt2")
+    params = get_model(cfg).init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, cfg.vocab_size)
+    greedy = decode.generate(params, prompt, cfg, 5)
+    k1p1 = decode.generate(
+        params, prompt, cfg, 5, temperature=1.0, key=jax.random.key(9),
+        top_k=1, top_p=1.0,
+    )
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1p1))
