@@ -85,6 +85,47 @@ def ddp_comm_bytes_per_step(
     return {"all_reduce": ar, "total": ar}
 
 
+def zero_memory_per_chip(
+    n_params: int,
+    n_chips: int,
+    *,
+    strategy: str = "full_shard",
+    param_bytes: int = 2,
+    grad_bytes: int | None = None,
+    opt_bytes: int | None = None,
+) -> dict:
+    """Per-chip STATE memory (params + grads + Adam moments) under each
+    ZeRO level — the analytic feasibility check for configs the rig
+    cannot run (e.g. BASELINE config 5, llama3-8B on v5e-64). Activation
+    memory is workload-dependent and excluded; treat the result as the
+    floor a chip must clear before batch size enters the picture.
+
+    opt_bytes: bytes per param for BOTH Adam moments together (default
+    2 * param_bytes)."""
+    grad_bytes = param_bytes if grad_bytes is None else grad_bytes
+    opt_bytes = 2 * param_bytes if opt_bytes is None else opt_bytes
+    n = max(1, n_chips)
+    full = {
+        "params": float(n_params * param_bytes),
+        "grads": float(n_params * grad_bytes),
+        "opt": float(n_params * opt_bytes),
+    }
+    sharded_keys = {
+        "full_shard": ("params", "grads", "opt"),  # ZeRO-3
+        "shard_grad_op": ("grads", "opt"),  # ZeRO-2
+        "shard_opt": ("opt",),  # ZeRO-1
+        "no_shard": (),  # DDP
+    }
+    if strategy not in sharded_keys:
+        raise ValueError(f"unknown strategy {strategy!r}")
+    out = {
+        k: (v / n if k in sharded_keys[strategy] else v)
+        for k, v in full.items()
+    }
+    out["total"] = sum(out.values())
+    return out
+
+
 def project_step(
     *,
     comm_bytes: float,

@@ -267,6 +267,32 @@ def _projection_for(rid: str, res: dict) -> dict | None:
     )
 
 
+def _llama8b_memory_note() -> str:
+    """Row-5 feasibility (llama3-8B never fits one chip): analytic ZeRO-3
+    per-chip state memory (unit-tested, profiling/comm_model.py)."""
+    sys.path.insert(0, str(REPO))
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        zero_memory_per_chip,
+    )
+
+    z16 = zero_memory_per_chip(
+        8_030_000_000, 16, strategy="full_shard", param_bytes=2,
+        grad_bytes=2, opt_bytes=8,
+    )
+    z64 = zero_memory_per_chip(
+        8_030_000_000, 64, strategy="full_shard", param_bytes=2,
+        grad_bytes=2, opt_bytes=8,
+    )
+    return (
+        f"- Row 5 feasibility (analytic, `zero_memory_per_chip`): "
+        f"llama3-8B under ZeRO-3 with bf16 params/grads + f32 moments "
+        f"needs {z16['total'] / 1e9:.1f} GB of state per chip on v5e-16 "
+        f"and {z64['total'] / 1e9:.1f} GB on v5e-64 (16 GB HBM each) — "
+        f"state fits from 16 chips up; per-layer gathered working set "
+        f"and activations set the usable batch."
+    )
+
+
 def write_artifacts(results: dict) -> None:
     outdir = REPO / "benchmarks"
     outdir.mkdir(exist_ok=True)
@@ -355,6 +381,7 @@ def write_artifacts(results: dict) -> None:
         "the comm model's assumptions (per-chip ICI 45-90 GB/s effective, "
         "overlap bracketed none..full, weak scaling), and no multi-chip "
         "measurement exists on this rig.",
+        _llama8b_memory_note(),
     ]
     (outdir / "RESULTS.md").write_text("\n".join(lines) + "\n")
     print(f"wrote {outdir / 'results.json'} and {outdir / 'RESULTS.md'}")
