@@ -21,7 +21,6 @@ teaching/trace parity) lives in parallel/explicit.py.
 
 from __future__ import annotations
 
-from typing import Callable
 
 import jax
 from jax.sharding import Mesh, NamedSharding

@@ -58,7 +58,7 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # stable location since jax 0.6
     from jax import shard_map
@@ -68,7 +68,6 @@ except ImportError:  # pragma: no cover
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 from pytorch_distributed_tpu.models import ModelApi
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
-from pytorch_distributed_tpu.ops.remat import apply_remat
 from pytorch_distributed_tpu.ops.tp import pvary_missing
 from pytorch_distributed_tpu.parallel.mesh import batch_partition_spec
 from pytorch_distributed_tpu.parallel.sharding import param_partition_specs
