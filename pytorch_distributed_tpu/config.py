@@ -47,6 +47,15 @@ class ModelConfig:
     embd_pdrop: float = 0.1
     attn_pdrop: float = 0.1
     resid_pdrop: float = 0.1
+    # Attention dropout under explicit tensor parallelism: "reject" (default
+    # — attn_pdrop > 0 with a tensor axis fails at build time, preserving
+    # the bitwise single-device parity contract) or "folded" (opt-in: each
+    # tensor shard folds its axis index into the attention-dropout key, so
+    # its local heads draw INDEPENDENT masks — statistically equivalent to
+    # the single-device draw, NOT bitwise-identical; embd/resid dropout
+    # keys stay replicated so non-attention activations remain
+    # bitwise-replicated across shards).
+    tensor_dropout: str = "reject"
 
     # Numerics: params kept in param_dtype, activations computed in dtype.
     dtype: str = "bfloat16"
@@ -139,6 +148,11 @@ class ModelConfig:
         if self.scan_unroll < 1:
             raise ValueError(
                 f"scan_unroll must be >= 1, got {self.scan_unroll}"
+            )
+        if self.tensor_dropout not in ("reject", "folded"):
+            raise ValueError(
+                f"unknown tensor_dropout: {self.tensor_dropout!r} "
+                "(implemented: reject, folded)"
             )
 
     @property

@@ -148,15 +148,28 @@ def _block(
     ``tensor_axis`` (explicit/shard_map TP): the block computes on its LOCAL
     heads / hidden columns. Megatron f (tp_copy) sits between each norm and
     the column-parallel matmul; the row-parallel projections psum
-    (tp_reduce, inside dense) before adding their replicated bias. Dropout
-    keys are identical across tensor shards, so the replicated activations
-    stay bitwise-replicated.
+    (tp_reduce, inside dense) before adding their replicated bias.
+    Embd/resid dropout keys are identical across tensor shards, so the
+    replicated activations stay bitwise-replicated; the attention-dropout
+    key is folded per shard (opt-in via cfg.tensor_dropout="folded") since
+    its masks act on head-sharded tensors.
     """
     eps = cfg.layer_norm_epsilon
     b, t = x.shape[:2]
 
     if layer_key is not None:
         k_attn, k_resid1, k_mlp = jax.random.split(layer_key, 3)
+        if tensor_axis is not None:
+            # Reached only under cfg.tensor_dropout="folded" (the explicit
+            # path rejects attn_pdrop + tensor otherwise): each shard's
+            # local heads draw independent attention-dropout masks —
+            # statistically equivalent to the single-device draw, not
+            # bitwise. k_resid1/k_mlp stay replicated: resid dropout acts
+            # on REPLICATED activations, which must stay bitwise-identical
+            # across shards for the TP psum algebra to hold.
+            k_attn = jax.random.fold_in(
+                k_attn, jax.lax.axis_index(tensor_axis)
+            )
     else:
         k_attn = k_resid1 = k_mlp = None
 
@@ -201,6 +214,7 @@ def _block(
             activation=activation(cfg.activation_function),
             capacity_factor=cfg.expert_capacity_factor,
             expert_axis=expert_axis,
+            tensor_axis=tensor_axis,
             top_k=cfg.moe_top_k,
             dispatch_impl=cfg.moe_dispatch,
         )
@@ -345,23 +359,36 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 
 def run_blocks(
-    blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None
-) -> jax.Array:
+    blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
+    return_aux: bool = False,
+):
     """Scan a stack of [L_local, ...] block params over x (L_local may be a
-    pipeline stage's slice of the full depth). Dense configs only — the
-    pipeline path rejects MoE at build time (aux loss is discarded here).
+    pipeline stage's slice of the full depth). With ``return_aux=True``
+    returns (x, aux) — the summed Switch load-balancing term over the LOCAL
+    layers (zero for dense configs); the pipeline path psums it over the
+    stage axis.
 
     ``block_transform`` (e.g. a per-layer fsdp all_gather) runs on each
     sliced layer INSIDE the rematted body, so backward re-gathers instead
     of saving gathered params (same contract as ``apply``'s)."""
+    from pytorch_distributed_tpu.ops.tp import pvary_missing
 
     def body(carry, bp):
+        h, aux_sum = carry
         if block_transform is not None:
             bp = block_transform(bp)
-        h, _aux = _block(carry, bp, cfg, None, True)
-        return h, None
+        h, aux = _block(h, bp, cfg, None, True)
+        return (h, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(apply_remat(body, cfg.remat), x, blocks)
+    aux0 = pvary_missing(
+        jnp.zeros((), jnp.float32),
+        tuple(getattr(jax.typeof(x), "vma", frozenset())),
+    )
+    (x, aux_total), _ = jax.lax.scan(
+        apply_remat(body, cfg.remat), (x, aux0), blocks
+    )
+    if return_aux:
+        return x, aux_total
     return x
 
 

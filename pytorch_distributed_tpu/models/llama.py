@@ -125,6 +125,7 @@ def _block(
             activation=jax.nn.silu,
             capacity_factor=cfg.expert_capacity_factor,
             expert_axis=expert_axis,
+            tensor_axis=tensor_axis,
             top_k=cfg.moe_top_k,
             dispatch_impl=cfg.moe_dispatch,
         )
@@ -227,18 +228,32 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 
 def run_blocks(
-    blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None
-) -> jax.Array:
+    blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
+    return_aux: bool = False,
+):
+    """See models/gpt2.py run_blocks — with ``return_aux=True`` returns
+    (x, aux), the local layers' summed Switch load-balancing term."""
+    from pytorch_distributed_tpu.ops.tp import pvary_missing
+
     t = x.shape[1]
     cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta)
 
     def body(carry, bp):
+        h, aux_sum = carry
         if block_transform is not None:
             bp = block_transform(bp)
-        h, _aux = _block(carry, bp, cfg, cos, sin)
-        return h, None
+        h, aux = _block(h, bp, cfg, cos, sin)
+        return (h, aux_sum + aux), None
 
-    x, _ = jax.lax.scan(apply_remat(body, cfg.remat), x, blocks)
+    aux0 = pvary_missing(
+        jnp.zeros((), jnp.float32),
+        tuple(getattr(jax.typeof(x), "vma", frozenset())),
+    )
+    (x, aux_total), _ = jax.lax.scan(
+        apply_remat(body, cfg.remat), (x, aux0), blocks
+    )
+    if return_aux:
+        return x, aux_total
     return x
 
 

@@ -114,6 +114,15 @@ def multi_head_attention(
             # sequence parallelism exists to avoid. "naive" is promoted to
             # flash (same math up to online-softmax reordering); an
             # explicit impl="flash" passes through unchanged.
+            if impl == "naive":
+                import warnings
+
+                warnings.warn(
+                    "impl='naive' with seq_impl='ulysses' is promoted to "
+                    "flash (same math up to online-softmax reordering); "
+                    "pass impl='flash' to silence this",
+                    stacklevel=2,
+                )
             return ulysses_attention(
                 q, k, v, axis_name=seq_axis, causal=causal,
                 impl="flash" if impl == "naive" else impl,

@@ -89,17 +89,30 @@ def _route(xt: jax.Array, router: jax.Array, top_k: int):
     return idx, gates, probs
 
 
-def _expert_compute(expert_in, params, activation, expert_axis):
+def _expert_compute(expert_in, params, activation, expert_axis,
+                    tensor_axis=None):
     """[X, C, D] expert batches -> [X, C, D] outputs, with the EP
     all_to_all pair when expert_axis is set. Dense experts:
     act(x @ w_in) @ w_out; gated (SwiGLU) experts with "w_gate":
-    (act(x @ w_gate) * (x @ w_in)) @ w_out."""
+    (act(x @ w_gate) * (x @ w_in)) @ w_out.
+
+    ``tensor_axis``: Megatron TP INSIDE each expert (EP x TP, the standard
+    large-MoE placement): w_in/w_gate are column-parallel on their hidden
+    dim F, w_out row-parallel on F, so each tensor shard computes its F/tp
+    slice and ONE psum (tp_reduce) after w_out restores the full [X, C, D]
+    output — the same f/g conjugate pair the dense blocks use (ops/tp.py).
+    The router and dispatch run on replicated activations, so routing is
+    identical across tensor shards."""
     if expert_axis is not None:
         # Send each expert's slots to its owning shard; slots from all
         # shards concatenate along the capacity dim.
         expert_in = jax.lax.all_to_all(
             expert_in, expert_axis, split_axis=0, concat_axis=1, tiled=True
         )  # [X/n, n*C, D]
+    if tensor_axis is not None:
+        from pytorch_distributed_tpu.ops.tp import tp_copy
+
+        expert_in = tp_copy(expert_in, tensor_axis)
     h = jnp.einsum(
         "xcd,xdf->xcf", expert_in, params["w_in"].astype(expert_in.dtype)
     )
@@ -114,6 +127,10 @@ def _expert_compute(expert_in, params, activation, expert_axis):
     expert_out = jnp.einsum(
         "xcf,xfd->xcd", h, params["w_out"].astype(h.dtype)
     )
+    if tensor_axis is not None:
+        from pytorch_distributed_tpu.ops.tp import tp_reduce
+
+        expert_out = tp_reduce(expert_out, tensor_axis)
     if expert_axis is not None:
         expert_out = jax.lax.all_to_all(
             expert_out, expert_axis, split_axis=1, concat_axis=0, tiled=True
@@ -142,7 +159,7 @@ def _assignment_positions(e_flat: jax.Array, n_experts: int):
 
 def _dispatch_einsum(
     xt, expert_idx, gates, n_experts, cap, params, activation, expert_axis,
-    out_dtype,
+    out_dtype, tensor_axis=None,
 ):
     """One-hot einsum dispatch (exact-parity / teaching path)."""
     t, k = expert_idx.shape
@@ -163,14 +180,16 @@ def _dispatch_einsum(
     expert_in = jnp.einsum(
         "txc,td->xcd", dispatch, xt.astype(jnp.float32)
     ).astype(out_dtype)  # [X, C, D]
-    expert_out = _expert_compute(expert_in, params, activation, expert_axis)
+    expert_out = _expert_compute(
+        expert_in, params, activation, expert_axis, tensor_axis
+    )
     out = jnp.einsum("txc,xcd->td", combine, expert_out.astype(jnp.float32))
     return out
 
 
 def _dispatch_sort(
     xt, expert_idx, gates, n_experts, cap, params, activation, expert_axis,
-    out_dtype,
+    out_dtype, tensor_axis=None,
 ):
     """Sort/segment dispatch: no [A, X, C] tensor, same semantics."""
     t, k = expert_idx.shape
@@ -197,7 +216,9 @@ def _dispatch_sort(
         .reshape(n_experts, cap, d)
     )
 
-    expert_out = _expert_compute(expert_in, params, activation, expert_axis)
+    expert_out = _expert_compute(
+        expert_in, params, activation, expert_axis, tensor_axis
+    )
 
     # Combine: each assignment gathers its slot's output, scaled by its
     # gate (0 for dropped), and segment-sums into its token.
@@ -219,6 +240,7 @@ def moe_mlp(
     activation,
     capacity_factor: float = 1.25,
     expert_axis: str | None = None,
+    tensor_axis: str | None = None,
     top_k: int = 1,
     dispatch_impl: str = "auto",
 ) -> tuple[jax.Array, jax.Array]:
@@ -259,12 +281,12 @@ def moe_mlp(
     if dispatch_impl == "einsum":
         out = _dispatch_einsum(
             xt, expert_idx, gates, n_experts, cap, params, activation,
-            expert_axis, x.dtype,
+            expert_axis, x.dtype, tensor_axis,
         )
     elif dispatch_impl == "sort":
         out = _dispatch_sort(
             xt, expert_idx, gates, n_experts, cap, params, activation,
-            expert_axis, x.dtype,
+            expert_axis, x.dtype, tensor_axis,
         )
     else:
         raise ValueError(f"unknown dispatch_impl {dispatch_impl!r}")

@@ -71,53 +71,20 @@ from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.tp import pvary_missing
 from pytorch_distributed_tpu.parallel.mesh import batch_partition_spec
 from pytorch_distributed_tpu.parallel.sharding import param_partition_specs
+from pytorch_distributed_tpu.parallel.zero import (
+    axis_dim as _axis_dim,
+    clip_by_global_norm_typed,
+    gather_params as _gather_params,
+    scatter_grads as _scatter_grads,
+    spec_has as _spec_has,
+    zero_sharded_update,
+)
 from pytorch_distributed_tpu.train.state import TrainState
 
 
 def _dp_axes(mesh_cfg: MeshConfig) -> tuple[str, ...]:
     """Axes the batch is split over (grad-reduction axes)."""
     return tuple(ax for ax in ("data", "fsdp") if getattr(mesh_cfg, ax) > 1)
-
-
-def _axis_dim(spec: P, axis: str = "fsdp") -> int | None:
-    """Dim index the named mesh axis shards in this spec (specs may carry
-    several axes — e.g. fsdp AND tensor — so the dim must be looked up by
-    name, not 'first sharded')."""
-    for i, entry in enumerate(spec):
-        if entry == axis or (isinstance(entry, tuple) and axis in entry):
-            return i
-    return None
-
-
-def _spec_has(spec: P, axis: str) -> bool:
-    return _axis_dim(spec, axis) is not None
-
-
-def _gather_params(params, specs):
-    """all_gather each fsdp-sharded leaf along its fsdp dim (tiled)."""
-
-    def gather(leaf, spec):
-        dim = _axis_dim(spec, "fsdp")
-        if dim is None:
-            return leaf
-        return jax.lax.all_gather(leaf, "fsdp", axis=dim, tiled=True)
-
-    return jax.tree.map(gather, params, specs)
-
-
-def _scatter_grads(grads, specs, fsdp_size: int):
-    """psum_scatter each leaf along its fsdp dim; leaves with no fsdp dim
-    get a plain psum. Produces the *sum* over the fsdp axis."""
-
-    def scatter(leaf, spec):
-        dim = _axis_dim(spec, "fsdp")
-        if dim is None:
-            return jax.lax.psum(leaf, "fsdp")
-        return jax.lax.psum_scatter(
-            leaf, "fsdp", scatter_dimension=dim, tiled=True
-        )
-
-    return jax.tree.map(scatter, grads, specs)
 
 
 def make_explicit_train_step(
@@ -154,10 +121,10 @@ def make_explicit_train_step(
                 f"n_experts={model_cfg.n_experts} not divisible by "
                 f"expert={mesh_cfg.expert}"
             )
-        if mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
+        if mesh_cfg.seq > 1:
             raise NotImplementedError(
-                "expert parallelism composes with the data and fsdp axes "
-                "(any ZeRO strategy), not with tensor/seq, for now"
+                "expert parallelism composes with the data, fsdp (any ZeRO "
+                "strategy) and tensor axes; the seq axis is future work"
             )
     if seq_axis is not None and model_cfg.attn_pdrop > 0:
         # Fail at build time, not mid-trace on the first step (ring attention
@@ -166,15 +133,21 @@ def make_explicit_train_step(
             "attention dropout is not supported with sequence parallelism "
             f"(attn_pdrop={model_cfg.attn_pdrop}); set attn_pdrop=0.0"
         )
-    if tensor_axis is not None and model_cfg.attn_pdrop > 0:
+    if (
+        tensor_axis is not None
+        and model_cfg.attn_pdrop > 0
+        and model_cfg.tensor_dropout != "folded"
+    ):
         # Per-shard draws from the replicated key would give head groups on
         # different shards identical (correlated) masks that also differ
         # from the single-device draw — silently breaking the parity
         # contract. No modern config trains with attention dropout anyway.
+        # cfg.tensor_dropout="folded" opts into per-shard folded keys
+        # (statistically equivalent, not bitwise — see config.py).
         raise NotImplementedError(
             "attention dropout is not supported with explicit tensor "
             f"parallelism (attn_pdrop={model_cfg.attn_pdrop}); set "
-            "attn_pdrop=0.0"
+            "attn_pdrop=0.0 or opt into tensor_dropout='folded'"
         )
     strategy = mesh_cfg.strategy
     fsdp_size = mesh_cfg.fsdp
@@ -406,49 +379,18 @@ def make_explicit_train_step(
         grad_norm = jnp.sqrt(sq)
 
         if grad_clip_norm is not None:
-            # optax.clip_by_global_norm semantics against the GLOBAL norm:
-            # identity when under the threshold, uniform (g/norm)*max scale
-            # when over — the same scale on every shard. The (invariant)
-            # norm is pcast up to each leaf's vma before mixing.
-            def clip_leaf(g):
-                gn = pvary_missing(
-                    grad_norm,
-                    tuple(getattr(g.aval, "vma", frozenset())),
-                )
-                return jnp.where(
-                    gn < grad_clip_norm, g, (g / gn) * grad_clip_norm
-                )
-
-            grads = jax.tree.map(clip_leaf, grads)
+            # Shared typed global-norm clip (parallel/zero.py) — same
+            # helper the pipeline path uses, so the semantics cannot
+            # diverge.
+            grads = clip_by_global_norm_typed(grads, grad_norm, grad_clip_norm)
 
         # --- update -------------------------------------------------------
         if strategy in ("shard_grad_op", "shard_opt") and fsdp_size > 1:
-            # ZeRO-2 / ZeRO-1 shared machinery: sharded Adam update on this
-            # device's fsdp slice, then re-gather full params. They differ
-            # only in what arrives here: shard_grad_op grads were
-            # reduce-scattered above (already sharded); shard_opt grads
-            # stayed replicated (all-reduced) and are sliced now.
-            params_shard = jax.tree.map(
-                lambda p, spec: _shard_slice(p, spec, fsdp_size),
-                state.params,
-                shard_specs,
-            )
-            grads_for_update = (
-                grads
-                if strategy == "shard_grad_op"
-                else jax.tree.map(
-                    lambda g, spec: _shard_slice(g, spec, fsdp_size),
-                    grads,
-                    shard_specs,
-                )
-            )
-            updates, new_opt_state = tx.update(
-                grads_for_update, state.opt_state, params_shard
-            )
-            new_params_shard = optax.apply_updates(params_shard, updates)
-            new_params = jax.tree.map(
-                lambda s, full, spec: _unscatter(s, full, spec),
-                new_params_shard, state.params, shard_specs,
+            # ZeRO-2 / ZeRO-1 sharded update + re-materialise
+            # (parallel/zero.py — shared with the pipeline path).
+            new_params, new_opt_state = zero_sharded_update(
+                tx, state.params, state.opt_state, grads, shard_specs,
+                fsdp_size, strategy,
             )
         else:
             updates, new_opt_state = tx.update(
@@ -479,31 +421,3 @@ def make_explicit_train_step(
     )
     return jax.jit(smapped, donate_argnums=(0,))
 
-
-def _shard_slice(full, spec: P, fsdp_size: int):
-    """Take this device's fsdp slice of a replicated array (ZeRO-2 update)."""
-    dim = _axis_dim(spec, "fsdp")
-    if dim is None:
-        return full
-    idx = jax.lax.axis_index("fsdp")
-    size = full.shape[dim] // fsdp_size
-    return jax.lax.dynamic_slice_in_dim(full, idx * size, size, axis=dim)
-
-
-def _unscatter(shard, full_like, spec: P):
-    """Rebuild the full replicated array from disjoint per-device shards
-    (inverse of ``_shard_slice``): pad to full size at this device's slice
-    and psum over "fsdp". Numerically identical to all_gather of the shards,
-    but typed INVARIANT over fsdp by the varying-manual-axes system —
-    all_gather output stays typed varying, which would fail the replicated
-    out_specs under check_vma. (Bandwidth 2x an all_gather; the teaching
-    path trades that for a machine-checked replication invariant.)"""
-    dim = _axis_dim(spec, "fsdp")
-    if dim is None:
-        return shard
-    idx = jax.lax.axis_index("fsdp")
-    size = shard.shape[dim]
-    padded = jax.lax.dynamic_update_slice_in_dim(
-        jnp.zeros(full_like.shape, shard.dtype), shard, idx * size, axis=dim
-    )
-    return jax.lax.psum(padded, "fsdp")

@@ -18,13 +18,21 @@ vocab-sized matmul); bubble ticks compute on garbage whose loss contribution
 — and therefore gradient — is exactly zero.
 
 Composes with the data axis (DDP: batch rows shard over "data", grads
-pmean over it) and with in-stage ZeRO-3 (strategy="full_shard", fsdp > 1:
-stage params/opt-state additionally shard over "fsdp", each scanned layer
-all_gathers just in time inside the rematted body and the gather's AD
-transpose reduce-scatters the grads — the same machinery as
-parallel/explicit.py). Deterministic mode only (dropout configs are
-rejected at build time, like the ring/TP paths). tensor/seq composition
-inside a stage is future work — rejected explicitly.
+pmean over it) and with the FULL in-stage ZeRO ladder over "fsdp":
+strategy="full_shard" (ZeRO-3: stage params/opt-state shard, each scanned
+layer all_gathers just in time inside the rematted body and the gather's
+AD transpose reduce-scatters the grads), "shard_grad_op" (ZeRO-2: params
+replicated in compute, grads reduce-scattered, sharded Adam +
+re-materialise), "shard_opt" (ZeRO-1: all-reduced grads, sharded Adam),
+"no_shard" (fsdp as a plain extra data axis) — the same machinery as
+parallel/explicit.py, whose helpers are reused. Global-norm grad clipping
+is applied against the pipe/fsdp-aware psum'd norm. MoE models run with
+experts replicated within each stage: every stage adds its local layers'
+Switch aux term to its loss (bubble ticks gated out), and the loss psum
+over "pipe" assembles CE + aux exactly as the single-device step does.
+Deterministic mode only (dropout configs are rejected at build time, like
+the ring/TP paths). tensor/seq composition inside a stage — and the
+"expert" mesh axis — are future work, rejected explicitly.
 
 Typed under check_vma: block params vary over "pipe" (sharded), replicated
 leaves (embeddings, final norm, head) are pvaried for local differentiation
@@ -56,46 +64,61 @@ from pytorch_distributed_tpu.train.state import TrainState
 
 def pipeline_state_specs(state: TrainState, mesh_cfg: MeshConfig):
     """Block leaves shard their stacked layer dim over "pipe"; everything
-    else replicates over pipe. Optimizer moments mirror the params tree.
+    else replicates over pipe.
 
-    In-stage ZeRO-3 (strategy="full_shard" with fsdp > 1): every leaf
-    additionally shards its largest remaining divisible weight dim over
-    "fsdp" — block leaves never their (pipe-owned) layer dim, embedding
-    tables never their vocab/position dim (same rules as
-    parallel/sharding.py)."""
-    fsdp = mesh_cfg.fsdp if mesh_cfg.strategy == "full_shard" else 1
+    The in-stage ZeRO ladder (fsdp > 1) mirrors parallel/sharding.py:
+    strategy="full_shard" (ZeRO-3) shards params AND optimizer moments —
+    every leaf's largest remaining divisible weight dim goes over "fsdp"
+    (block leaves never their pipe-owned layer dim, embedding tables never
+    their vocab/position dim); "shard_grad_op" (ZeRO-2) and "shard_opt"
+    (ZeRO-1) keep params replicated over fsdp but shard the optimizer
+    moments in the layout params WOULD have under full_shard; "no_shard"
+    treats fsdp as a plain extra data axis."""
+    fsdp_params = mesh_cfg.fsdp if mesh_cfg.strategy == "full_shard" else 1
+    fsdp_opt = (
+        mesh_cfg.fsdp
+        if mesh_cfg.strategy in ("full_shard", "shard_grad_op", "shard_opt")
+        else 1
+    )
 
-    def spec_for(path, leaf):
-        keys = [getattr(p, "key", None) for p in path]
-        ndim = getattr(leaf, "ndim", 0)
-        shape = tuple(getattr(leaf, "shape", ()))
-        if ndim == 0:
-            return P()
-        spec: list = [None] * ndim
-        stacked = "blocks" in keys
-        if stacked:
-            spec[0] = "pipe"
-        if fsdp > 1:
-            embedding = bool(keys) and keys[-1] in ("wte", "wpe")
-            min_dim = 1 if (stacked or embedding) else 0
-            best_dim, best_size = None, 0
-            for i, s in enumerate(shape):
-                if (
-                    i >= min_dim
-                    and spec[i] is None
-                    and s % fsdp == 0
-                    and s >= best_size
-                    and s > 1
-                ):
-                    best_dim, best_size = i, s
-            if best_dim is not None:
-                spec[best_dim] = "fsdp"
-        if all(ax is None for ax in spec):
-            return P()
-        return P(*spec)
+    def make_spec_for(fsdp):
+        def spec_for(path, leaf):
+            keys = [getattr(p, "key", None) for p in path]
+            ndim = getattr(leaf, "ndim", 0)
+            shape = tuple(getattr(leaf, "shape", ()))
+            if ndim == 0:
+                return P()
+            spec: list = [None] * ndim
+            stacked = "blocks" in keys
+            if stacked:
+                spec[0] = "pipe"
+            if fsdp > 1:
+                embedding = bool(keys) and keys[-1] in ("wte", "wpe")
+                min_dim = 1 if (stacked or embedding) else 0
+                best_dim, best_size = None, 0
+                for i, s in enumerate(shape):
+                    if (
+                        i >= min_dim
+                        and spec[i] is None
+                        and s % fsdp == 0
+                        and s >= best_size
+                        and s > 1
+                    ):
+                        best_dim, best_size = i, s
+                if best_dim is not None:
+                    spec[best_dim] = "fsdp"
+            if all(ax is None for ax in spec):
+                return P()
+            return P(*spec)
 
-    p_specs = jax.tree_util.tree_map_with_path(spec_for, state.params)
-    o_specs = jax.tree_util.tree_map_with_path(spec_for, state.opt_state)
+        return spec_for
+
+    p_specs = jax.tree_util.tree_map_with_path(
+        make_spec_for(fsdp_params), state.params
+    )
+    o_specs = jax.tree_util.tree_map_with_path(
+        make_spec_for(fsdp_opt), state.opt_state
+    )
     return TrainState(params=p_specs, opt_state=o_specs, step=P())
 
 
@@ -119,6 +142,7 @@ def make_pipeline_train_step(
     train_cfg: TrainConfig | None = None,
     *,
     schedule: str = "gpipe",
+    grad_clip_norm: float | None = None,
 ) -> Callable:
     """Build the jitted pipelined (state, batch, key) -> (state, metrics)
     step. ``batch`` is [M, B_global, T]; M (the grad-accumulation factor)
@@ -132,31 +156,39 @@ def make_pipeline_train_step(
     slots at the cost of one full-stage recompute per backward tick).
     Both produce identical numbers (equivalence-tested).
 
-    Pass ``train_cfg`` so unsupported optimizer couplings are rejected at
-    build time: gradient clipping's global norm would mix pipe-sharded and
-    replicated leaves inside shard_map (a check_vma error at trace time
-    otherwise)."""
+    ``grad_clip_norm``: global-norm gradient clipping, computed from the
+    pipe/fsdp-aware global norm (per-leaf squared sums psum'd over exactly
+    the axes each leaf is sharded over), so every stage applies the SAME
+    clip scale. The ``tx`` passed in must be clip-free
+    (``make_optimizer(cfg, with_clip=False)``) — optax's clip inside
+    shard_map would compute a stage-local norm, silently applying a
+    different scale per stage (same contract as
+    parallel/explicit.py:make_explicit_train_step)."""
     if mesh_cfg.pipe <= 1:
         raise ValueError("pipeline path needs mesh_cfg.pipe > 1")
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(
             f"unknown pipeline schedule {schedule!r} (gpipe, 1f1b)"
         )
-    if train_cfg is not None and train_cfg.grad_clip_norm:
-        raise NotImplementedError(
-            "grad_clip_norm is not supported on the pipeline path: the clip "
-            "scale must be computed from a pipe-aware global norm"
+    if (
+        train_cfg is not None
+        and train_cfg.grad_clip_norm
+        and grad_clip_norm is None
+    ):
+        # The caller's tx was presumably built WITH optax's clip element,
+        # which inside shard_map would clip against a stage-LOCAL norm.
+        raise ValueError(
+            "grad_clip_norm on the pipeline path must be applied by this "
+            "step against the pipe-aware global norm: build the optimizer "
+            "with make_optimizer(cfg, with_clip=False) and pass "
+            "grad_clip_norm= explicitly"
         )
     if mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
         raise NotImplementedError(
             "pipeline composes with the data and fsdp axes (in-stage "
             "tensor/seq sharding is future work)"
         )
-    if mesh_cfg.fsdp > 1 and mesh_cfg.strategy != "full_shard":
-        raise NotImplementedError(
-            "pipeline + fsdp supports strategy='full_shard' (in-stage "
-            "ZeRO-3) only"
-        )
+    strategy = mesh_cfg.strategy
     if (
         model_cfg.embd_pdrop > 0
         or model_cfg.attn_pdrop > 0
@@ -165,10 +197,11 @@ def make_pipeline_train_step(
         raise NotImplementedError(
             "pipeline path is deterministic-only; zero the pdrop fields"
         )
-    if model_cfg.n_experts:
+    if mesh_cfg.expert > 1:
         raise NotImplementedError(
-            "MoE models are not supported on the pipeline path (the aux "
-            "loss would need stage-aware plumbing)"
+            "the expert mesh axis does not compose with pipeline yet: MoE "
+            "models run on the pipeline path with experts replicated "
+            "within each stage (set expert=1)"
         )
     n_stages = mesh_cfg.pipe
     if model_cfg.n_layer % n_stages != 0:
@@ -184,6 +217,16 @@ def make_pipeline_train_step(
     perm = [(i, i + 1) for i in range(n_stages - 1)]
 
     specs = pipeline_state_specs(state, mesh_cfg)
+    # ZeRO-2/1 slice replicated params/grads into the layout they WOULD
+    # have under full_shard (explicit-path contract, explicit.py:188-192).
+    if strategy in ("shard_grad_op", "shard_opt") and fsdp_size > 1:
+        import dataclasses
+
+        shard_param_specs = pipeline_state_specs(
+            state, dataclasses.replace(mesh_cfg, strategy="full_shard")
+        ).params
+    else:
+        shard_param_specs = None
     # fsdp is data parallelism with sharded state: batch rows split over it.
     batch_axes = tuple(
         ax for ax in ("data", "fsdp") if getattr(mesh_cfg, ax) > 1
@@ -197,15 +240,13 @@ def make_pipeline_train_step(
     def _vary(x):
         return pvary_missing(x, vary_axes)
 
-    if fsdp_size > 1:
+    if fsdp_size > 1 and strategy == "full_shard":
         # In-stage ZeRO-3: non-block leaves gather up front; each scanned
         # layer gathers its own block slice just in time inside the
         # (rematted) scan body — backward re-gathers and the gather's AD
         # transpose IS the gradient reduce-scatter (same machinery as
         # parallel/explicit.py, whose helpers are reused).
-        from pytorch_distributed_tpu.parallel.explicit import (
-            _gather_params,
-        )
+        from pytorch_distributed_tpu.parallel.zero import gather_params
 
         block_specs = jax.tree.map(
             lambda s: P(*s[1:]),
@@ -214,11 +255,11 @@ def make_pipeline_train_step(
         )
 
         def gather_block(bp):
-            return _gather_params(bp, block_specs)
+            return gather_params(bp, block_specs)
 
         def gather_nonblock(params):
             return {
-                k: (v if k == "blocks" else _gather_params(v, specs.params[k]))
+                k: (v if k == "blocks" else gather_params(v, specs.params[k]))
                 for k, v in params.items()
             }
 
@@ -250,10 +291,25 @@ def make_pipeline_train_step(
                 ),
                 lambda: x_buf,
             )
-            y = model.run_blocks(
-                params["blocks"], x_in, model_cfg,
-                block_transform=gather_block,
-            )
+            if model_cfg.n_experts:
+                y, aux = model.run_blocks(
+                    params["blocks"], x_in, model_cfg,
+                    block_transform=gather_block, return_aux=True,
+                )
+                # Stage s computes on microbatch tk - s; bubble ticks run
+                # on garbage whose router aux is nonzero — gate it out so
+                # only real microbatches' load-balancing terms contribute.
+                valid_mb = (tk - stage >= 0) & (tk - stage < m)
+                aux_t = (
+                    jnp.where(valid_mb, aux, 0.0).astype(jnp.float32)
+                    * model_cfg.moe_aux_coef
+                )
+            else:
+                y = model.run_blocks(
+                    params["blocks"], x_in, model_cfg,
+                    block_transform=gather_block,
+                )
+                aux_t = 0.0
             out_idx = tk - (n_stages - 1)
             valid_out = (stage == n_stages - 1) & (out_idx >= 0)
             loss_t = jax.lax.cond(
@@ -268,7 +324,7 @@ def make_pipeline_train_step(
                 lambda: _vary(jnp.zeros((), jnp.float32)),
             )
             x_next = jax.lax.ppermute(y, "pipe", perm)
-            return (x_next, loss_acc + loss_t), None
+            return (x_next, loss_acc + loss_t + aux_t), None
 
         x0 = _vary(
             jnp.zeros((b, t, model_cfg.n_embd), jnp.dtype(model_cfg.dtype))
@@ -278,7 +334,8 @@ def make_pipeline_train_step(
             (x0, _vary(jnp.zeros((), jnp.float32))),
             jnp.arange(n_ticks),
         )
-        # Only the last stage accumulated loss; psum replicates the mean.
+        # CE accumulated on the last stage; MoE aux terms on every stage —
+        # the psum over pipe assembles the full loss and replicates it.
         return jax.lax.psum(loss_sum, "pipe") / m
 
     grad_fn = jax.value_and_grad(forward_loss)
@@ -310,10 +367,21 @@ def make_pipeline_train_step(
                 lambda: model.embed(params, tok, model_cfg),
                 lambda: x,
             )
-            y = model.run_blocks(
-                params["blocks"], x0, model_cfg,
-                block_transform=gather_block,
-            )
+            if model_cfg.n_experts:
+                # Per-stage local loss includes this stage's layers' aux
+                # term; B ticks only ever run on real microbatches (is_b
+                # gating below), so no bubble-garbage gate is needed here.
+                y, aux = model.run_blocks(
+                    params["blocks"], x0, model_cfg,
+                    block_transform=gather_block, return_aux=True,
+                )
+                aux_t = aux.astype(jnp.float32) * model_cfg.moe_aux_coef
+            else:
+                y = model.run_blocks(
+                    params["blocks"], x0, model_cfg,
+                    block_transform=gather_block,
+                )
+                aux_t = _vary(jnp.zeros((), jnp.float32))
             loss = jax.lax.cond(
                 stage == n_stages - 1,
                 lambda: cross_entropy_loss(
@@ -321,7 +389,7 @@ def make_pipeline_train_step(
                 ),
                 lambda: _vary(jnp.zeros((), jnp.float32)),
             )
-            return y, loss
+            return y, loss + aux_t
 
         def mb_slices(idx):
             tok = jax.lax.dynamic_index_in_dim(
@@ -377,12 +445,14 @@ def make_pipeline_train_step(
                     lambda p, x: stage_apply(p, x, tok_b, tgt_b),
                     vparams, x_saved,
                 )
-                # Seed: the last stage differentiates its own mean-scaled
-                # loss; other stages chain the arriving cotangent into y.
+                # Seed: every stage differentiates its own mean-scaled
+                # local loss (the CE term lives on the last stage; the MoE
+                # aux term on every stage — for dense configs non-final
+                # stages' loss is the constant 0 and the seed is inert);
+                # non-final stages additionally chain the arriving
+                # cotangent into y.
                 dy = jnp.where(stage == n_stages - 1, 0.0, 1.0) * bwd_in
-                dl = jnp.where(
-                    stage == n_stages - 1, 1.0 / m, 0.0
-                ).astype(jnp.float32)
+                dl = jnp.full((), 1.0 / m, jnp.float32)
                 dp, dx = vjp((dy.astype(y_p.dtype), _vary(dl)))
                 return dp, dx.astype(dt), loss_p
 
@@ -434,34 +504,55 @@ def make_pipeline_train_step(
             specs.params,
         )
         if fsdp_size > 1:
-            # fsdp-sharded leaves: the gather's AD transpose SUMMED the
-            # per-shard grads over fsdp (reduce-scatter) — normalise to a
-            # mean; leaves with no fsdp dim are per-shard partials over the
-            # fsdp batch slice — a real pmean.
-            grads = jax.tree.map(
-                lambda g, spec: (
-                    g / fsdp_size
-                    if _has_axis(spec, "fsdp")
-                    else jax.lax.pmean(g, "fsdp")
-                ),
-                grads,
-                specs.params,
-            )
+            if strategy == "full_shard":
+                # fsdp-sharded leaves: the gather's AD transpose SUMMED the
+                # per-shard grads over fsdp (reduce-scatter) — normalise to
+                # a mean; leaves with no fsdp dim are per-shard partials
+                # over the fsdp batch slice — a real pmean.
+                grads = jax.tree.map(
+                    lambda g, spec: (
+                        g / fsdp_size
+                        if _has_axis(spec, "fsdp")
+                        else jax.lax.pmean(g, "fsdp")
+                    ),
+                    grads,
+                    specs.params,
+                )
+            elif strategy == "shard_grad_op":
+                # In-stage ZeRO-2: params stayed replicated over fsdp in
+                # compute, so grads are per-shard batch partials —
+                # reduce-scatter them to fsdp shards (+ normalise the sum
+                # to a mean). The update below runs on the shards.
+                from pytorch_distributed_tpu.parallel.zero import (
+                    scatter_grads,
+                )
+
+                grads = scatter_grads(grads, shard_param_specs, fsdp_size)
+                grads = jax.tree.map(lambda g: g / fsdp_size, grads)
+            else:
+                # ZeRO-1 / no_shard: plain DDP all-reduce(AVG) over fsdp.
+                grads = jax.lax.pmean(grads, "fsdp")
             loss = jax.lax.pmean(loss, "fsdp")
         if data_axis:
             grads = jax.lax.pmean(grads, data_axis)
             loss = jax.lax.pmean(loss, data_axis)
 
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-
         # Per-leaf squared sums psum'd over exactly the axes the leaf is
         # sharded over (pipe and/or fsdp); replicated leaves unsummed.
+        # Computed BEFORE the update so it can drive clipping. Under
+        # ZeRO-2 the grads were just reduce-scattered, so the fsdp-psum
+        # axes come from the SHARD layout, not the (replicated) param
+        # layout.
+        norm_specs = (
+            shard_param_specs
+            if strategy == "shard_grad_op" and fsdp_size > 1
+            else specs.params
+        )
         buckets: dict = {}
         for g, spec in zip(
             jax.tree.leaves(grads),
             jax.tree.leaves(
-                specs.params, is_leaf=lambda x: isinstance(x, P)
+                norm_specs, is_leaf=lambda x: isinstance(x, P)
             ),
         ):
             axes = tuple(
@@ -478,6 +569,35 @@ def make_pipeline_train_step(
                 val = jax.lax.psum(val, ax)
             sq = sq + val
         grad_norm = jnp.sqrt(sq)
+
+        if grad_clip_norm is not None:
+            # Shared typed global-norm clip (parallel/zero.py) — the SAME
+            # helper the explicit path uses, so clip semantics cannot
+            # diverge between the two shard_map paths.
+            from pytorch_distributed_tpu.parallel.zero import (
+                clip_by_global_norm_typed,
+            )
+
+            grads = clip_by_global_norm_typed(grads, grad_norm, grad_clip_norm)
+
+        if strategy in ("shard_grad_op", "shard_opt") and fsdp_size > 1:
+            # ZeRO-2 / ZeRO-1 sharded update + re-materialise on the
+            # pipe-local param slices (parallel/zero.py — shared with the
+            # explicit path).
+            from pytorch_distributed_tpu.parallel.zero import (
+                zero_sharded_update,
+            )
+
+            new_params, new_opt_state = zero_sharded_update(
+                tx, state.params, state.opt_state, grads,
+                shard_param_specs, fsdp_size, strategy,
+            )
+        else:
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+
         metrics = {"loss": loss, "grad_norm": grad_norm}
         return TrainState(new_params, new_opt_state, state.step + 1), metrics
 

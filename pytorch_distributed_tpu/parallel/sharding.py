@@ -60,6 +60,15 @@ _TENSOR_RULES: dict[tuple[str, ...], int] = {
     ("mlp", "gate"): 2,
     ("mlp", "up"): 2,
     ("mlp", "down"): 1,
+    # MoE expert FFNs (EP x TP): stacked [L, X, D, F] / [L, X, F, D] leaves
+    # run Megatron TP INSIDE each expert — w_in/w_gate column-parallel on
+    # the hidden dim F, w_out row-parallel on F (ops/moe.py
+    # _expert_compute's tp_copy/tp_reduce pair). The router stays
+    # replicated (routing must agree across tensor shards). Composes with
+    # the "expert" dim-1 sharding below.
+    ("mlp", "w_in"): 3,
+    ("mlp", "w_gate"): 3,
+    ("mlp", "w_out"): 2,
 }
 _TENSOR_SUFFIX_LENS = (3, 2)
 

@@ -191,3 +191,91 @@ def project_fsdp_mfu(
             "per-chip batch); single-chip measured compute time"
         ),
     }
+
+
+def ring_attention_comm_bytes_per_step(
+    *,
+    n_layer: int,
+    batch: int,
+    t_local: int,
+    kv_dim: int,
+    n_chips: int,
+    dtype_bytes: int = 2,
+    ring_passes: float = 3.0,
+) -> dict:
+    """Per-chip ppermute traffic of ring (context-parallel) attention
+    (ops/ring_attention.py): each ring pass streams every OTHER chip's K
+    and V blocks through this chip — (n_chips - 1) hops x 2 tensors x
+    [batch, t_local, kv_dim] bytes — once per layer.
+
+    ring_passes: 1 forward + ~2 backward (the rematted recompute ring plus
+    the dK/dV accumulation ring) = 3 by default; an assumption, bracketed
+    by the ici band like everything else in this module.
+    """
+    if n_chips < 2:
+        return {"ppermute": 0.0, "total": 0.0}
+    per_layer = (n_chips - 1) * 2.0 * batch * t_local * kv_dim * dtype_bytes
+    total = ring_passes * n_layer * per_layer
+    return {"ppermute": total, "total": total}
+
+
+def project_ring_mfu(
+    *,
+    measured_ms_per_step: float,
+    n_params: int,
+    n_layer: int,
+    n_embd: int,
+    kv_dim: int,
+    batch: int,
+    t_local: int,
+    n_chips: int,
+    dtype_bytes: int = 2,
+    ring_passes: float = 3.0,
+    chip: ChipSpec = V5E,
+) -> dict:
+    """Project a measured single-chip long-context step (T = t_local) onto
+    an n_chips ring-attention mesh holding T_global = n_chips * t_local.
+
+    Sequence (weak) scaling: each chip keeps its B x t_local token shard,
+    so per-token attention FLOPs grow with the GLOBAL context — per-chip
+    compute time scales by fpt(T_global) / fpt(T_local) at constant
+    compute efficiency — and the ring's KV ppermute traffic lands on top
+    (overlap bracketed none..full, like project_fsdp_mfu).
+    """
+    t_global = n_chips * t_local
+    fpt_local = 6.0 * n_params + 12.0 * n_layer * n_embd * t_local
+    fpt_global = 6.0 * n_params + 12.0 * n_layer * n_embd * t_global
+    compute_ms = measured_ms_per_step * fpt_global / fpt_local
+    traffic = ring_attention_comm_bytes_per_step(
+        n_layer=n_layer, batch=batch, t_local=t_local, kv_dim=kv_dim,
+        n_chips=n_chips, dtype_bytes=dtype_bytes, ring_passes=ring_passes,
+    )
+    proj = project_step(
+        comm_bytes=traffic["total"], compute_ms=compute_ms, chip=chip
+    )
+    best_ms, worst_ms = proj["step_ms_band"]
+    tokps_band = (
+        batch * t_local / worst_ms * 1e3,
+        batch * t_local / best_ms * 1e3,
+    )
+    return {
+        "chip": chip.name,
+        "n_chips": n_chips,
+        "t_global": t_global,
+        "comm_bytes_per_step": traffic,
+        "comm_ms_band": proj["comm_ms_band"],
+        "compute_ms": compute_ms,
+        "step_ms_band": (best_ms, worst_ms),
+        "tokps_per_chip_band": tokps_band,
+        "mfu_pct_band": tuple(
+            t * fpt_global / chip.peak_bf16_flops * 100 for t in tokps_band
+        ),
+        "assumptions": (
+            f"{chip.name} public specs; ici_eff "
+            f"{chip.ici_eff_low/1e9:.0f}-{chip.ici_eff_high/1e9:.0f} GB/s; "
+            f"overlap bracketed none..full; sequence weak scaling (same "
+            f"B x T_local per chip, attention FLOPs at T_global); "
+            f"{ring_passes:.0f} ring passes/layer (fwd + remat recompute + "
+            "dK/dV)"
+        ),
+    }

@@ -84,14 +84,49 @@ def _op_threads(trace: dict, pids: set[int]) -> set[tuple[int, int]]:
     return keys
 
 
+# XLA:CPU runtime threads that execute HLO thunks (the virtual-device rig,
+# --xla_force_host_platform_device_count): per-op events carry the SAME HLO
+# instruction names the TPU path emits (all_gather.N, reduce_scatter.N,
+# fusion.N, ...), so classify_op's HLO-name pinning
+# (tests/test_hlo_collectives.py) applies unchanged.
+_CPU_RUNTIME_THREADS = ("tf_XLAEigen", "tf_XLAPjRtCpuClient")
+# Runtime bookkeeping rows interleaved with the op rows on those threads:
+# "end: <op>" cleanup markers (would double-count the op name) and the
+# thunk-executor / threadpool / transpose-plan internals that NEST around
+# real ops.
+_CPU_INFRA_PREFIXES = (
+    "end: ", "ThunkExecutor", "ThreadpoolListener", "Transpose",
+)
+
+
+def _cpu_runtime_threads(trace: dict) -> set[tuple[int, int]]:
+    keys = set()
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tname = (e.get("args") or {}).get("name", "")
+            if tname.startswith(_CPU_RUNTIME_THREADS):
+                keys.add((e["pid"], e["tid"]))
+    return keys
+
+
 def device_op_events(trace: dict) -> list[dict]:
     """Complete ('X') events on device per-op tracks:
-    [{name, ts, dur, pid, tid, category}]."""
+    [{name, ts, dur, pid, tid, category}].
+
+    Falls back to the XLA:CPU runtime threads when the trace has no
+    TPU/GPU device tracks (a virtual-device CPU capture): the CPU backend
+    runs HLO thunks on host threadpool threads, and its per-op rows — real
+    collectives included — are the same analysis surface."""
     pids = _device_pids(trace)
     threads = _op_threads(trace, pids)
+    cpu_fallback = not threads
+    if cpu_fallback:
+        threads = _cpu_runtime_threads(trace)
     out = []
     for e in trace.get("traceEvents", []):
         if e.get("ph") != "X" or (e.get("pid"), e.get("tid")) not in threads:
+            continue
+        if cpu_fallback and e["name"].startswith(_CPU_INFRA_PREFIXES):
             continue
         dur = float(e.get("dur", 0.0))
         out.append(

@@ -99,11 +99,18 @@ class DistributedTrainer(Trainer):
                 shard_pipeline_state,
             )
 
+            from pytorch_distributed_tpu.train.optim import make_optimizer
+
             state, _ = shard_pipeline_state(state, self.mesh, self.mesh_cfg)
+            # Clip-free optimizer: the pipeline step clips against the
+            # pipe/fsdp-aware psum'd global norm itself (same contract as
+            # the explicit path below).
             self.train_step = make_pipeline_train_step(
-                self.model, self.model_cfg, self.tx, self.mesh,
+                self.model, self.model_cfg,
+                make_optimizer(self.train_cfg, with_clip=False), self.mesh,
                 self.mesh_cfg, state, self.train_cfg,
                 schedule=self.mesh_cfg.pipe_schedule,
+                grad_clip_norm=self.train_cfg.grad_clip_norm,
             )
             return state
         state, _ = shard_train_state(state, self.mesh, self.mesh_cfg)

@@ -171,16 +171,29 @@ def build_train_cfg(args, *, data_parallel_size: int = 1):
     return cfg
 
 
+def _local_shards(args) -> list[str]:
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(args.data_dir, "*.bin")))
+    if not paths:
+        raise SystemExit(
+            f"--data local: no *.bin shards in {args.data_dir!r} "
+            "(produce some with scripts/tokenize_text.py)"
+        )
+    return paths
+
+
 def shard_paths(args, vocab_size: int) -> list[str]:
     if args.data == "local":
-        import glob
-
-        paths = sorted(glob.glob(os.path.join(args.data_dir, "*.bin")))
-        if not paths:
-            raise SystemExit(
-                f"--data local: no *.bin shards in {args.data_dir!r} "
-                "(produce some with scripts/tokenize_text.py)"
+        paths = _local_shards(args)
+        # Hold the last shard out for validation ONLY when this run
+        # actually evaluates — a train-only run keeps its whole corpus.
+        if len(paths) > 1 and getattr(args, "eval_batches", 0) > 0:
+            print(
+                f"--data local: holding out {paths[-1]!r} as the "
+                f"validation shard (training on {len(paths) - 1} shard(s))"
             )
+            return paths[:-1]
         return paths
     if args.data == "fineweb":
         from pytorch_distributed_tpu.data.download import (
@@ -206,10 +219,16 @@ def val_shard_paths(args, vocab_size: int) -> list[str]:
     """Validation data: the fineweb val shard (reference
     data_loader.py:28-41 downloads it; nothing there ever reads it), a
     held-out synthetic shard from a disjoint seed, or — for --data local —
-    the LAST local shard (hold it out of training yourself if you need a
-    clean split)."""
+    the LAST local shard (held out of training by shard_paths when there
+    is more than one shard)."""
     if args.data == "local":
-        return [shard_paths(args, vocab_size)[-1]]
+        paths = _local_shards(args)
+        if len(paths) == 1:
+            print(
+                "WARNING: --data local has a single shard; validation "
+                "overlaps training data, so val loss is optimistic"
+            )
+        return [paths[-1]]
     if args.data == "fineweb":
         from pathlib import Path
 

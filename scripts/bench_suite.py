@@ -78,6 +78,22 @@ ROWS = {
         measured=False,  # 8B does not fit one chip in any dtype
         mesh=dict(fsdp=8, strategy="full_shard"),
     ),
+    # Long context (beyond the BASELINE table, benchmarks/PERF_NOTES.md
+    # "Long-context datapoint"): T=4096 trains on ONE chip thanks to the
+    # flash kernel's O(T) memory + fused head/CE; T=8192 exceeds one
+    # chip's HBM and is what the ring-attention seq-parallel path shards
+    # -- projected as row 6p from the ring comm model.
+    6: dict(
+        name="llama3-1B long-context T=4096",
+        preset="llama3-1b",
+        parallelism="none",
+        measured=True,
+        batch=1,
+        seq_len=4096,
+        param_dtype="bfloat16",
+        fused_head_ce=True,
+        ring_projection=dict(n_chips=2),  # T_global=8192 over seq=2
+    ),
 }
 
 V5E_PEAK_BF16 = 197e12
@@ -96,7 +112,7 @@ def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
     from pytorch_distributed_tpu.utils.prng import domain_key
 
     seed = int.from_bytes(os.urandom(4), "little")
-    B, T = row["batch"], 1024
+    B, T = row["batch"], row.get("seq_len", 1024)
     cfg = model_config(
         row["preset"], dtype="bfloat16", param_dtype=row["param_dtype"]
     ).replace(
@@ -104,7 +120,8 @@ def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
         remat="names",
         logits_dtype="bfloat16",
         embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
-        n_ctx=1024,  # benchmark sequence length (llama presets default 8192)
+        n_ctx=T,  # benchmark sequence length (llama presets default 8192)
+        fused_head_ce=row.get("fused_head_ce", False),
     )
     model = get_model(cfg)
     tcfg = TrainConfig(
@@ -159,6 +176,8 @@ def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
         kind="measured",
         platform=jax.devices()[0].platform,
         n_params=n_params,
+        n_layer=cfg.n_layer, n_embd=cfg.n_embd,
+        kv_dim=cfg.kv_heads * cfg.head_dim,
         batch=B, seq_len=T,
         tokens_per_sec_per_chip=round(tok_s, 1),
         ms_per_step=round(B * T / tok_s * 1e3, 1),
@@ -267,6 +286,31 @@ def _projection_for(rid: str, res: dict) -> dict | None:
     )
 
 
+def _ring_projection_for(rid: str, res: dict) -> dict | None:
+    """Ring-attention sequence-parallel projection for a measured
+    long-context row: T_global = n_chips * T_local over a seq mesh
+    (profiling/comm_model.py project_ring_mfu, unit-tested)."""
+    row = ROWS[int(rid)]
+    rp = row.get("ring_projection")
+    if rp is None or res.get("kind") != "measured":
+        return None
+    if "n_layer" not in res:
+        return None  # row measured by an older suite version; re-measure
+    sys.path.insert(0, str(REPO))
+    from pytorch_distributed_tpu.profiling.comm_model import project_ring_mfu
+
+    return project_ring_mfu(
+        measured_ms_per_step=res["ms_per_step"],
+        n_params=res["n_params"],
+        n_layer=res["n_layer"],
+        n_embd=res["n_embd"],
+        kv_dim=res["kv_dim"],
+        batch=res["batch"],
+        t_local=res["seq_len"],
+        n_chips=rp["n_chips"],
+    )
+
+
 def _llama8b_memory_note() -> str:
     """Row-5 feasibility (llama3-8B never fits one chip): analytic ZeRO-3
     per-chip state memory (unit-tested, profiling/comm_model.py)."""
@@ -309,6 +353,9 @@ def write_artifacts(results: dict) -> None:
         proj = _projection_for(rid, res)
         if proj is not None:
             res["v5e16_projection"] = proj
+        rproj = _ring_projection_for(rid, res)
+        if rproj is not None:
+            res["ring_projection"] = rproj
     (outdir / "results.json").write_text(json.dumps(results, indent=1))
 
     lines = [
@@ -351,6 +398,19 @@ def write_artifacts(results: dict) -> None:
                     f"{lo:.1f}-{hi:.1f}% | PROJECTED (analytic comm model; "
                     f"not a measurement) |"
                 )
+            rproj = res.get("ring_projection")
+            if rproj is not None:
+                lo, hi = rproj["mfu_pct_band"]
+                s_lo, s_hi = rproj["step_ms_band"]
+                n = rproj["n_chips"]
+                lines.append(
+                    f"| {rid}p | {row['name']} -> ring seq{n} "
+                    f"T={rproj['t_global']} | seq{n} (ring attention) | "
+                    f"{rproj['tokps_per_chip_band'][0]:,.0f}-"
+                    f"{rproj['tokps_per_chip_band'][1]:,.0f} | "
+                    f"{s_lo:.0f}-{s_hi:.0f} | {lo:.1f}-{hi:.1f}% | "
+                    f"PROJECTED (ring comm model; not a measurement) |"
+                )
         else:
             status = (
                 "correctness-only (virtual CPU mesh)"
@@ -372,8 +432,9 @@ def write_artifacts(results: dict) -> None:
         "",
         "Notes:",
         "- MFU = tok/s x (6N + 12·L·E·T) / 197e12 (v5e bf16 peak).",
-        "- All measured rows: T=1024, bf16 activations, Pallas flash "
-        "attention, named-saves remat, bf16 logits, no dropout.",
+        "- All measured rows: T=1024 unless the row names a longer "
+        "context, bf16 activations, Pallas flash attention, named-saves "
+        "remat, bf16 logits, no dropout.",
         "- ~1B-param rows use bf16 optimizer state to fit one chip's HBM; "
         "multi-chip f32-state runs are what the mesh configs are for.",
         "- The BASELINE.md north star (>=40% MFU for 1B FSDP on v5e-16) is "
@@ -389,7 +450,7 @@ def write_artifacts(results: dict) -> None:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--rows", default="1,2,3,4,5")
+    ap.add_argument("--rows", default="1,2,3,4,5,6")
     ap.add_argument("--windows", type=int, default=3)
     # 48-step windows match bench.py: the per-window device_get fence costs
     # a fixed relay round-trip that short windows charge to throughput; by

@@ -50,7 +50,8 @@ def main() -> int:
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--top-p", type=float, default=None,
                     help="nucleus sampling: smallest token set whose "
-                         "probability mass reaches p (overrides --top-k)")
+                         "probability mass reaches p (applies within "
+                         "--top-k when both are set)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 

@@ -42,7 +42,9 @@ def main() -> int:
 
         tok = AutoTokenizer.from_pretrained(args.hf_tokenizer)
         encode = lambda text: tok.encode(text)  # noqa: E731
-        vocab = tok.vocab_size
+        # len(tok) counts added/special tokens (eos can be >= vocab_size);
+        # tok.vocab_size would under-size the embedding-table guidance.
+        vocab = len(tok)
         separator = tok.eos_token_id
         if separator is None:
             print(

@@ -118,3 +118,43 @@ def test_llama8b_fits_v5e16_under_zero3():
     # And the same model can NEVER sit on one chip, any strategy.
     one = zero_memory_per_chip(8_000_000_000, 1, strategy="full_shard")
     assert one["total"] > V5E.hbm_bytes
+
+
+def test_ring_attention_comm_bytes():
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        ring_attention_comm_bytes_per_step,
+    )
+
+    assert ring_attention_comm_bytes_per_step(
+        n_layer=4, batch=2, t_local=8, kv_dim=4, n_chips=1
+    )["total"] == 0.0
+    r = ring_attention_comm_bytes_per_step(
+        n_layer=2, batch=2, t_local=8, kv_dim=4, n_chips=4,
+        dtype_bytes=2, ring_passes=3.0,
+    )
+    # (n-1) hops x 2 (K,V) x B x T_local x kv_dim x bytes, x layers x passes
+    per_layer = 3 * 2 * 2 * 8 * 4 * 2
+    assert r["total"] == 3.0 * 2 * per_layer
+
+
+def test_project_ring_mfu_bands_sane():
+    """Sequence weak scaling: compute scales with the global-context flops
+    per token; the step band brackets compute..compute+comm; MFU stays in
+    (0, 100]."""
+    from pytorch_distributed_tpu.profiling.comm_model import project_ring_mfu
+
+    r = project_ring_mfu(
+        measured_ms_per_step=383.0, n_params=1_240_000_000,
+        n_layer=16, n_embd=2048, kv_dim=512, batch=1, t_local=4096,
+        n_chips=2,
+    )
+    assert r["t_global"] == 8192
+    assert r["compute_ms"] > 383.0  # attention term grows with T_global
+    best, worst = r["step_ms_band"]
+    assert best >= r["compute_ms"] - 1e-9
+    assert worst >= best
+    lo, hi = r["mfu_pct_band"]
+    assert 0 < lo <= hi <= 100
+    # tok/s ordering mirrors the step band.
+    t_lo, t_hi = r["tokps_per_chip_band"]
+    assert t_lo <= t_hi
