@@ -360,7 +360,7 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
 
 def run_blocks(
     blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
-    return_aux: bool = False,
+    return_aux: bool = False, tensor_axis: str | None = None,
 ):
     """Scan a stack of [L_local, ...] block params over x (L_local may be a
     pipeline stage's slice of the full depth). With ``return_aux=True``
@@ -370,14 +370,18 @@ def run_blocks(
 
     ``block_transform`` (e.g. a per-layer fsdp all_gather) runs on each
     sliced layer INSIDE the rematted body, so backward re-gathers instead
-    of saving gathered params (same contract as ``apply``'s)."""
+    of saving gathered params (same contract as ``apply``'s).
+
+    ``tensor_axis``: blocks compute Megatron-style on their local
+    heads/columns with tp_copy/tp_reduce at the region boundaries
+    (in-stage TP for the pipeline path)."""
     from pytorch_distributed_tpu.ops.tp import pvary_missing
 
     def body(carry, bp):
         h, aux_sum = carry
         if block_transform is not None:
             bp = block_transform(bp)
-        h, aux = _block(h, bp, cfg, None, True)
+        h, aux = _block(h, bp, cfg, None, True, None, tensor_axis)
         return (h, aux_sum + aux), None
 
     aux0 = pvary_missing(

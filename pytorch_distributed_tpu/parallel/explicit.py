@@ -72,7 +72,6 @@ from pytorch_distributed_tpu.ops.tp import pvary_missing
 from pytorch_distributed_tpu.parallel.mesh import batch_partition_spec
 from pytorch_distributed_tpu.parallel.sharding import param_partition_specs
 from pytorch_distributed_tpu.parallel.zero import (
-    axis_dim as _axis_dim,
     clip_by_global_norm_typed,
     gather_params as _gather_params,
     scatter_grads as _scatter_grads,
@@ -121,11 +120,11 @@ def make_explicit_train_step(
                 f"n_experts={model_cfg.n_experts} not divisible by "
                 f"expert={mesh_cfg.expert}"
             )
-        if mesh_cfg.seq > 1:
-            raise NotImplementedError(
-                "expert parallelism composes with the data, fsdp (any ZeRO "
-                "strategy) and tensor axes; the seq axis is future work"
-            )
+        # seq composes too: context parallelism shards the TOKEN dim, and
+        # routing is per-token — each seq shard routes its local tokens
+        # through the same all_to_all expert exchange (capacity counted
+        # per shard, like the data axis). Equivalence-tested in
+        # tests/test_moe.py.
     if seq_axis is not None and model_cfg.attn_pdrop > 0:
         # Fail at build time, not mid-trace on the first step (ring attention
         # has no attention-dropout support, ops/attention.py).

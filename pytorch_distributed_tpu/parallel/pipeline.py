@@ -30,8 +30,12 @@ is applied against the pipe/fsdp-aware psum'd norm. MoE models run with
 experts replicated within each stage: every stage adds its local layers'
 Switch aux term to its loss (bubble ticks gated out), and the loss psum
 over "pipe" assembles CE + aux exactly as the single-device step does.
-Deterministic mode only (dropout configs are rejected at build time, like
-the ring/TP paths). tensor/seq composition inside a stage — and the
+In-stage Megatron TP over "tensor" (classic 3D parallelism): block params
+shard head-/column-aligned per parallel/sharding.py's rule table, blocks
+compute on local heads with the tp_copy/tp_reduce conjugates, and the
+norm/clip machinery psums tensor-sharded leaves' contributions over
+"tensor". Deterministic mode only (dropout configs are rejected at build
+time, like the ring/TP paths). seq composition inside a stage — and the
 "expert" mesh axis — are future work, rejected explicitly.
 
 Typed under check_vma: block params vary over "pipe" (sharded), replicated
@@ -43,6 +47,7 @@ the last stage), so the psum reconstructs the exact full gradient.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable
 
 import jax
@@ -59,6 +64,13 @@ from pytorch_distributed_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from pytorch_distributed_tpu.models import ModelApi
 from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
 from pytorch_distributed_tpu.ops.tp import pvary_missing
+from pytorch_distributed_tpu.parallel.zero import (
+    clip_by_global_norm_typed,
+    gather_params,
+    scatter_grads,
+    spec_has as _has_axis,
+    zero_sharded_update,
+)
 from pytorch_distributed_tpu.train.state import TrainState
 
 
@@ -66,47 +78,43 @@ def pipeline_state_specs(state: TrainState, mesh_cfg: MeshConfig):
     """Block leaves shard their stacked layer dim over "pipe"; everything
     else replicates over pipe.
 
-    The in-stage ZeRO ladder (fsdp > 1) mirrors parallel/sharding.py:
-    strategy="full_shard" (ZeRO-3) shards params AND optimizer moments —
-    every leaf's largest remaining divisible weight dim goes over "fsdp"
-    (block leaves never their pipe-owned layer dim, embedding tables never
-    their vocab/position dim); "shard_grad_op" (ZeRO-2) and "shard_opt"
-    (ZeRO-1) keep params replicated over fsdp but shard the optimizer
-    moments in the layout params WOULD have under full_shard; "no_shard"
-    treats fsdp as a plain extra data axis."""
-    fsdp_params = mesh_cfg.fsdp if mesh_cfg.strategy == "full_shard" else 1
-    fsdp_opt = (
-        mesh_cfg.fsdp
-        if mesh_cfg.strategy in ("full_shard", "shard_grad_op", "shard_opt")
-        else 1
+    In-stage sharding reuses parallel/sharding.py's rule table
+    (``_leaf_spec``): tensor > 1 claims each block leaf's Megatron dim
+    (head-aligned QKV, row/column-parallel projections, expert FFNs);
+    the in-stage ZeRO ladder then shards the largest remaining divisible
+    weight dim over "fsdp" — strategy="full_shard" (ZeRO-3) for params
+    AND optimizer moments (block leaves never their pipe-owned layer dim,
+    embedding tables never their vocab/position dim); "shard_grad_op"
+    (ZeRO-2) and "shard_opt" (ZeRO-1) keep params replicated over fsdp
+    but shard the optimizer moments in the layout params WOULD have under
+    full_shard; "no_shard" treats fsdp as a plain extra data axis."""
+    from pytorch_distributed_tpu.parallel.sharding import _leaf_spec
+
+    fsdp_params = mesh_cfg.strategy == "full_shard"
+    fsdp_opt = mesh_cfg.strategy in (
+        "full_shard", "shard_grad_op", "shard_opt"
     )
 
-    def make_spec_for(fsdp):
+    def make_spec_for(shard_fsdp):
         def spec_for(path, leaf):
             keys = [getattr(p, "key", None) for p in path]
             ndim = getattr(leaf, "ndim", 0)
             shape = tuple(getattr(leaf, "shape", ()))
             if ndim == 0:
                 return P()
-            spec: list = [None] * ndim
             stacked = "blocks" in keys
+            embedding = bool(keys) and keys[-1] in ("wte", "wpe")
+            base = _leaf_spec(
+                shape,
+                mesh_cfg,
+                path=path,
+                shard_fsdp=shard_fsdp,
+                min_dim=1 if (stacked or embedding) else 0,
+            )
+            spec = list(base) + [None] * (ndim - len(base))
             if stacked:
+                assert spec[0] is None, (keys, spec)
                 spec[0] = "pipe"
-            if fsdp > 1:
-                embedding = bool(keys) and keys[-1] in ("wte", "wpe")
-                min_dim = 1 if (stacked or embedding) else 0
-                best_dim, best_size = None, 0
-                for i, s in enumerate(shape):
-                    if (
-                        i >= min_dim
-                        and spec[i] is None
-                        and s % fsdp == 0
-                        and s >= best_size
-                        and s > 1
-                    ):
-                        best_dim, best_size = i, s
-                if best_dim is not None:
-                    spec[best_dim] = "fsdp"
             if all(ax is None for ax in spec):
                 return P()
             return P(*spec)
@@ -183,10 +191,10 @@ def make_pipeline_train_step(
             "with make_optimizer(cfg, with_clip=False) and pass "
             "grad_clip_norm= explicitly"
         )
-    if mesh_cfg.tensor > 1 or mesh_cfg.seq > 1:
+    if mesh_cfg.seq > 1:
         raise NotImplementedError(
-            "pipeline composes with the data and fsdp axes (in-stage "
-            "tensor/seq sharding is future work)"
+            "pipeline composes with the data, fsdp, and tensor axes "
+            "(in-stage seq sharding is future work)"
         )
     strategy = mesh_cfg.strategy
     if (
@@ -210,6 +218,7 @@ def make_pipeline_train_step(
             f"pipe={n_stages} stages"
         )
     data_axis = "data" if mesh_cfg.data > 1 else None
+    tensor_axis = "tensor" if mesh_cfg.tensor > 1 else None
     fsdp_size = mesh_cfg.fsdp
     # No wrap-around pair: stage 0 always takes the embed branch, so shipping
     # the last stage's activation back to it would be a wasted hop; ppermute
@@ -220,8 +229,6 @@ def make_pipeline_train_step(
     # ZeRO-2/1 slice replicated params/grads into the layout they WOULD
     # have under full_shard (explicit-path contract, explicit.py:188-192).
     if strategy in ("shard_grad_op", "shard_opt") and fsdp_size > 1:
-        import dataclasses
-
         shard_param_specs = pipeline_state_specs(
             state, dataclasses.replace(mesh_cfg, strategy="full_shard")
         ).params
@@ -246,7 +253,6 @@ def make_pipeline_train_step(
         # (rematted) scan body — backward re-gathers and the gather's AD
         # transpose IS the gradient reduce-scatter (same machinery as
         # parallel/explicit.py, whose helpers are reused).
-        from pytorch_distributed_tpu.parallel.zero import gather_params
 
         block_specs = jax.tree.map(
             lambda s: P(*s[1:]),
@@ -295,6 +301,7 @@ def make_pipeline_train_step(
                 y, aux = model.run_blocks(
                     params["blocks"], x_in, model_cfg,
                     block_transform=gather_block, return_aux=True,
+                    tensor_axis=tensor_axis,
                 )
                 # Stage s computes on microbatch tk - s; bubble ticks run
                 # on garbage whose router aux is nonzero — gate it out so
@@ -308,6 +315,7 @@ def make_pipeline_train_step(
                 y = model.run_blocks(
                     params["blocks"], x_in, model_cfg,
                     block_transform=gather_block,
+                    tensor_axis=tensor_axis,
                 )
                 aux_t = 0.0
             out_idx = tk - (n_stages - 1)
@@ -374,12 +382,14 @@ def make_pipeline_train_step(
                 y, aux = model.run_blocks(
                     params["blocks"], x0, model_cfg,
                     block_transform=gather_block, return_aux=True,
+                    tensor_axis=tensor_axis,
                 )
                 aux_t = aux.astype(jnp.float32) * model_cfg.moe_aux_coef
             else:
                 y = model.run_blocks(
                     params["blocks"], x0, model_cfg,
                     block_transform=gather_block,
+                    tensor_axis=tensor_axis,
                 )
                 aux_t = _vary(jnp.zeros((), jnp.float32))
             loss = jax.lax.cond(
@@ -523,10 +533,6 @@ def make_pipeline_train_step(
                 # compute, so grads are per-shard batch partials —
                 # reduce-scatter them to fsdp shards (+ normalise the sum
                 # to a mean). The update below runs on the shards.
-                from pytorch_distributed_tpu.parallel.zero import (
-                    scatter_grads,
-                )
-
                 grads = scatter_grads(grads, shard_param_specs, fsdp_size)
                 grads = jax.tree.map(lambda g: g / fsdp_size, grads)
             else:
@@ -556,9 +562,10 @@ def make_pipeline_train_step(
             ),
         ):
             axes = tuple(
-                ax for ax in ("pipe", "fsdp")
+                ax for ax in ("pipe", "fsdp", "tensor")
                 if _has_axis(spec, ax)
                 and (ax != "fsdp" or fsdp_size > 1)
+                and (ax != "tensor" or tensor_axis is not None)
             )
             buckets[axes] = buckets.get(axes, 0.0) + jnp.sum(
                 jnp.square(g.astype(jnp.float32))
@@ -574,20 +581,12 @@ def make_pipeline_train_step(
             # Shared typed global-norm clip (parallel/zero.py) — the SAME
             # helper the explicit path uses, so clip semantics cannot
             # diverge between the two shard_map paths.
-            from pytorch_distributed_tpu.parallel.zero import (
-                clip_by_global_norm_typed,
-            )
-
             grads = clip_by_global_norm_typed(grads, grad_norm, grad_clip_norm)
 
         if strategy in ("shard_grad_op", "shard_opt") and fsdp_size > 1:
             # ZeRO-2 / ZeRO-1 sharded update + re-materialise on the
             # pipe-local param slices (parallel/zero.py — shared with the
             # explicit path).
-            from pytorch_distributed_tpu.parallel.zero import (
-                zero_sharded_update,
-            )
-
             new_params, new_opt_state = zero_sharded_update(
                 tx, state.params, state.opt_state, grads,
                 shard_param_specs, fsdp_size, strategy,
@@ -613,13 +612,6 @@ def make_pipeline_train_step(
         check_vma=True,
     )
     return jax.jit(smapped, donate_argnums=(0,))
-
-
-def _has_axis(spec: P, axis: str) -> bool:
-    return any(
-        entry == axis or (isinstance(entry, tuple) and axis in entry)
-        for entry in spec
-    )
 
 
 def _has_pipe(spec: P) -> bool:

@@ -62,7 +62,6 @@ def main() -> None:
     )
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.parallel.mesh import make_mesh
-    from pytorch_distributed_tpu.train import checkpoint as ckpt_lib
     from pytorch_distributed_tpu.train.distributed_trainer import (
         DistributedTrainer,
     )

@@ -448,11 +448,29 @@ def test_expert_tensor_actually_shards_both_axes(eight_devices):
     assert specs["blocks"]["mlp"]["router"] == P(), specs["blocks"]["mlp"]
 
 
-def test_expert_seq_still_rejected(eight_devices):
-    cfg, model, tx, *_ = _ep_reference()
-    mcfg = MeshConfig(expert=2, seq=2, data=2, strategy="no_shard")
+@pytest.mark.parametrize(
+    "expert,seq,data,family",
+    [
+        (2, 2, 2, "gpt2"),
+        (2, 4, 1, "gpt2"),
+        (2, 2, 2, "llama"),
+    ],
+)
+def test_expert_seq_composition_matches_single_device(
+    eight_devices, expert, seq, data, family
+):
+    """EP x ring-attention context parallelism: the token dim shards over
+    "seq" (positions offset per shard, ring attention), each seq shard
+    routes its LOCAL tokens through the expert all_to_all, and the
+    composed step reproduces the single-device result (aux coef 0 for
+    exact parity — routing is per-token, so seq sharding cannot change
+    assignments)."""
+    cfg, model, tx, batch, ref_state, ref_m = _ep_reference(family=family)
+    mcfg = MeshConfig(expert=expert, seq=seq, data=data, strategy="no_shard")
     mesh = make_mesh(mcfg)
     state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
     state, _ = shard_train_state(state, mesh, mcfg)
-    with pytest.raises(NotImplementedError, match="seq"):
-        make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
+    step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
+    put = make_batch_put(mesh, mcfg)
+    new_state, m = step(state, put(batch), jax.random.key(0))
+    _assert_matches_ref(new_state, m, ref_state, ref_m)

@@ -92,8 +92,6 @@ def test_workers_agree(mp2_run):
 def test_matches_single_process_reference(mp2_run):
     """The 2-proc x 2-device fsdp=4 async-checkpointed run reproduces a
     single-process 4-virtual-device run on the same global token stream."""
-    import jax
-
     from pytorch_distributed_tpu.config import ModelConfig, TrainConfig
     from pytorch_distributed_tpu.data.loader import TokenShardLoader
     from pytorch_distributed_tpu.models import get_model
