@@ -361,6 +361,7 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
 def run_blocks(
     blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
     return_aux: bool = False, tensor_axis: str | None = None,
+    expert_axis: str | None = None,
 ):
     """Scan a stack of [L_local, ...] block params over x (L_local may be a
     pipeline stage's slice of the full depth). With ``return_aux=True``
@@ -374,14 +375,18 @@ def run_blocks(
 
     ``tensor_axis``: blocks compute Megatron-style on their local
     heads/columns with tp_copy/tp_reduce at the region boundaries
-    (in-stage TP for the pipeline path)."""
+    (in-stage TP for the pipeline path). ``expert_axis``: MoE expert
+    weights shard over it and tokens route through the all_to_all
+    exchange (in-stage EP)."""
     from pytorch_distributed_tpu.ops.tp import pvary_missing
 
     def body(carry, bp):
         h, aux_sum = carry
         if block_transform is not None:
             bp = block_transform(bp)
-        h, aux = _block(h, bp, cfg, None, True, None, tensor_axis)
+        h, aux = _block(
+            h, bp, cfg, None, True, None, tensor_axis, expert_axis
+        )
         return (h, aux_sum + aux), None
 
     aux0 = pvary_missing(

@@ -230,6 +230,7 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
 def run_blocks(
     blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
     return_aux: bool = False, tensor_axis: str | None = None,
+    expert_axis: str | None = None,
 ):
     """See models/gpt2.py run_blocks — with ``return_aux=True`` returns
     (x, aux), the local layers' summed Switch load-balancing term;
@@ -244,7 +245,9 @@ def run_blocks(
         h, aux_sum = carry
         if block_transform is not None:
             bp = block_transform(bp)
-        h, aux = _block(h, bp, cfg, cos, sin, None, tensor_axis)
+        h, aux = _block(
+            h, bp, cfg, cos, sin, None, tensor_axis, expert_axis
+        )
         return (h, aux_sum + aux), None
 
     aux0 = pvary_missing(

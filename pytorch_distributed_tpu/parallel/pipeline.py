@@ -26,17 +26,21 @@ replicated in compute, grads reduce-scattered, sharded Adam +
 re-materialise), "shard_opt" (ZeRO-1: all-reduced grads, sharded Adam),
 "no_shard" (fsdp as a plain extra data axis) — the same machinery as
 parallel/explicit.py, whose helpers are reused. Global-norm grad clipping
-is applied against the pipe/fsdp-aware psum'd norm. MoE models run with
-experts replicated within each stage: every stage adds its local layers'
-Switch aux term to its loss (bubble ticks gated out), and the loss psum
-over "pipe" assembles CE + aux exactly as the single-device step does.
-In-stage Megatron TP over "tensor" (classic 3D parallelism): block params
-shard head-/column-aligned per parallel/sharding.py's rule table, blocks
-compute on local heads with the tp_copy/tp_reduce conjugates, and the
-norm/clip machinery psums tensor-sharded leaves' contributions over
-"tensor". Deterministic mode only (dropout configs are rejected at build
-time, like the ring/TP paths). seq composition inside a stage — and the
-"expert" mesh axis — are future work, rejected explicitly.
+is applied against the pipe/fsdp-aware psum'd norm. MoE models run either
+with experts replicated within each stage or with in-stage EXPERT
+parallelism over "expert" (each stage's expert weights shard, its local
+tokens route through the all_to_all exchange, and "expert" doubles as a
+batch axis — the placement real MoE training uses); every stage adds its
+local layers' Switch aux term to its loss (bubble ticks gated out), and
+the loss psum over "pipe" assembles CE + aux exactly as the
+single-device step does. In-stage Megatron TP over "tensor" (classic 3D
+parallelism): block params shard head-/column-aligned per
+parallel/sharding.py's rule table, blocks compute on local heads with
+the tp_copy/tp_reduce conjugates, and the norm/clip machinery psums
+tensor-sharded leaves' contributions over "tensor". Deterministic mode
+only (dropout configs are rejected at build time, like the ring/TP
+paths). seq composition inside a stage is future work, rejected
+explicitly.
 
 Typed under check_vma: block params vary over "pipe" (sharded), replicated
 leaves (embeddings, final norm, head) are pvaried for local differentiation
@@ -206,11 +210,15 @@ def make_pipeline_train_step(
             "pipeline path is deterministic-only; zero the pdrop fields"
         )
     if mesh_cfg.expert > 1:
-        raise NotImplementedError(
-            "the expert mesh axis does not compose with pipeline yet: MoE "
-            "models run on the pipeline path with experts replicated "
-            "within each stage (set expert=1)"
-        )
+        if not model_cfg.n_experts:
+            raise ValueError(
+                "expert axis > 1 needs an MoE model (n_experts > 0)"
+            )
+        if model_cfg.n_experts % mesh_cfg.expert:
+            raise ValueError(
+                f"n_experts={model_cfg.n_experts} not divisible by "
+                f"expert={mesh_cfg.expert}"
+            )
     n_stages = mesh_cfg.pipe
     if model_cfg.n_layer % n_stages != 0:
         raise ValueError(
@@ -219,6 +227,7 @@ def make_pipeline_train_step(
         )
     data_axis = "data" if mesh_cfg.data > 1 else None
     tensor_axis = "tensor" if mesh_cfg.tensor > 1 else None
+    expert_axis = "expert" if mesh_cfg.expert > 1 else None
     fsdp_size = mesh_cfg.fsdp
     # No wrap-around pair: stage 0 always takes the embed branch, so shipping
     # the last stage's activation back to it would be a wasted hop; ppermute
@@ -236,12 +245,16 @@ def make_pipeline_train_step(
         shard_param_specs = None
     # fsdp is data parallelism with sharded state: batch rows split over it.
     batch_axes = tuple(
-        ax for ax in ("data", "fsdp") if getattr(mesh_cfg, ax) > 1
+        ax
+        for ax in ("data", "fsdp", "expert")
+        if getattr(mesh_cfg, ax) > 1
     ) or None
     batch_spec = P(None, batch_axes, None)
 
     vary_axes = ("pipe",) + tuple(
-        ax for ax in ("data", "fsdp") if getattr(mesh_cfg, ax) > 1
+        ax
+        for ax in ("data", "fsdp", "expert")
+        if getattr(mesh_cfg, ax) > 1
     )
 
     def _vary(x):
@@ -301,7 +314,7 @@ def make_pipeline_train_step(
                 y, aux = model.run_blocks(
                     params["blocks"], x_in, model_cfg,
                     block_transform=gather_block, return_aux=True,
-                    tensor_axis=tensor_axis,
+                    tensor_axis=tensor_axis, expert_axis=expert_axis,
                 )
                 # Stage s computes on microbatch tk - s; bubble ticks run
                 # on garbage whose router aux is nonzero — gate it out so
@@ -382,7 +395,7 @@ def make_pipeline_train_step(
                 y, aux = model.run_blocks(
                     params["blocks"], x0, model_cfg,
                     block_transform=gather_block, return_aux=True,
-                    tensor_axis=tensor_axis,
+                    tensor_axis=tensor_axis, expert_axis=expert_axis,
                 )
                 aux_t = aux.astype(jnp.float32) * model_cfg.moe_aux_coef
             else:
@@ -513,6 +526,17 @@ def make_pipeline_train_step(
             grads,
             specs.params,
         )
+        if expert_axis is not None:
+            grads = jax.tree.map(
+                lambda g, spec: (
+                    g / mesh_cfg.expert
+                    if _has_axis(spec, "expert")
+                    else jax.lax.pmean(g, expert_axis)
+                ),
+                grads,
+                specs.params,
+            )
+            loss = jax.lax.pmean(loss, expert_axis)
         if fsdp_size > 1:
             if strategy == "full_shard":
                 # fsdp-sharded leaves: the gather's AD transpose SUMMED the
@@ -562,10 +586,11 @@ def make_pipeline_train_step(
             ),
         ):
             axes = tuple(
-                ax for ax in ("pipe", "fsdp", "tensor")
+                ax for ax in ("pipe", "fsdp", "tensor", "expert")
                 if _has_axis(spec, ax)
                 and (ax != "fsdp" or fsdp_size > 1)
                 and (ax != "tensor" or tensor_axis is not None)
+                and (ax != "expert" or expert_axis is not None)
             )
             buckets[axes] = buckets.get(axes, 0.0) + jnp.sum(
                 jnp.square(g.astype(jnp.float32))
