@@ -69,6 +69,10 @@ ROWS = {
         measured=True,
         batch=4,
         param_dtype="bfloat16",
+        # A/B'd round 4 (scripts/perf_ab.py): dots beats names by ~1.3%
+        # on the SwiGLU family (13.7k vs 13.5k tok/s); gpt2 rows keep
+        # names (names beats dots by ~4% at 1.3B).
+        remat="dots",
         mesh=dict(fsdp=8, strategy="full_shard"),
     ),
     5: dict(
@@ -91,6 +95,11 @@ ROWS = {
         batch=1,
         seq_len=4096,
         param_dtype="bfloat16",
+        # A/B'd round 4: at T=4096 "names" WINS (11.2k tok/s / 60.4% MFU
+        # vs dots 10.3k / 55.7%) even though dots wins at T=1024 (row 4)
+        # — at long context the quadratic-in-T attention recompute that
+        # names avoids dominates the policy tradeoff.
+        remat="names",
         fused_head_ce=True,
         ring_projection=dict(n_chips=2),  # T_global=8192 over seq=2
     ),
@@ -117,7 +126,7 @@ def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
         row["preset"], dtype="bfloat16", param_dtype=row["param_dtype"]
     ).replace(
         attention_impl="flash",
-        remat="names",
+        remat=row.get("remat", "names"),
         logits_dtype="bfloat16",
         embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
         n_ctx=T,  # benchmark sequence length (llama presets default 8192)

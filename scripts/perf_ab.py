@@ -1,12 +1,14 @@
 """A/B perf experiments on the real chip (bench.py methodology).
 
-Times the GPT-2 124M bench config under config variants (e.g. scan-unroll
-factors) with fresh seeds and long fenced windows — the measurement-hygiene
-rules from benchmarks/PERF_NOTES.md. One JSON line per variant.
+Times a bench config under config variants (e.g. scan-unroll factors,
+remat policies) with fresh seeds and long fenced windows — the
+measurement-hygiene rules from benchmarks/PERF_NOTES.md. One JSON line
+per variant.
 
 Usage:
   python scripts/perf_ab.py --variants unroll1,unroll2,unroll4
-  python scripts/perf_ab.py --variants unroll1,unroll2 --windows 2
+  python scripts/perf_ab.py --preset llama3-1b --param-dtype bfloat16 \
+      --batch-size 4 --variants names,dots,unroll2
 """
 
 from __future__ import annotations
@@ -28,6 +30,7 @@ VARIANTS = {
     "unroll4": dict(scan_unroll=4),
     "unroll6": dict(scan_unroll=6),
     "unroll12": dict(scan_unroll=12),
+    "names": dict(),  # the default policy, as the A/B baseline
     "dots": dict(remat="dots"),
     "no_remat": dict(remat="none"),
     "full_remat": dict(remat="full"),
@@ -36,7 +39,8 @@ VARIANTS = {
 
 def run_variant(name: str, overrides: dict, *, windows: int,
                 window_steps: int, batch_size: int = 8,
-                seq_len: int = 1024) -> dict:
+                seq_len: int = 1024, preset: str = "gpt2",
+                param_dtype: str = "float32") -> dict:
     import jax
     import numpy as np
 
@@ -53,7 +57,9 @@ def run_variant(name: str, overrides: dict, *, windows: int,
         attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0,
     )
     base.update(overrides)
-    cfg = model_config("gpt2", dtype="bfloat16").replace(**base)
+    cfg = model_config(
+        preset, dtype="bfloat16", param_dtype=param_dtype
+    ).replace(n_ctx=seq_len, **base)
     model = get_model(cfg)
     tcfg = TrainConfig(
         global_batch_size=batch_size, micro_batch_size=batch_size,
@@ -104,11 +110,17 @@ def main() -> None:
     ap.add_argument("--variants", default="unroll1,unroll2,unroll4")
     ap.add_argument("--windows", type=int, default=2)
     ap.add_argument("--window-steps", type=int, default=48)
+    ap.add_argument("--preset", default="gpt2")
+    ap.add_argument("--param-dtype", default="float32")
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seq-len", type=int, default=1024)
     args = ap.parse_args()
     for name in args.variants.split(","):
         res = run_variant(
             name, VARIANTS[name], windows=args.windows,
-            window_steps=args.window_steps,
+            window_steps=args.window_steps, batch_size=args.batch_size,
+            seq_len=args.seq_len, preset=args.preset,
+            param_dtype=args.param_dtype,
         )
         print(json.dumps(res), flush=True)
 
