@@ -131,6 +131,7 @@ def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
         embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
         n_ctx=T,  # benchmark sequence length (llama presets default 8192)
         fused_head_ce=row.get("fused_head_ce", False),
+        **row.get("cfg_overrides", {}),
     )
     model = get_model(cfg)
     tcfg = TrainConfig(
@@ -442,8 +443,9 @@ def write_artifacts(results: dict) -> None:
         "Notes:",
         "- MFU = tok/s x (6N + 12·L·E·T) / 197e12 (v5e bf16 peak).",
         "- All measured rows: T=1024 unless the row names a longer "
-        "context, bf16 activations, Pallas flash attention, named-saves "
-        "remat, bf16 logits, no dropout.",
+        "context, bf16 activations, Pallas flash attention, bf16 logits, "
+        "no dropout; remat policy is per-row (ROWS[n]['remat'], "
+        "A/B-measured optimum — 'names' unless stated).",
         "- ~1B-param rows use bf16 optimizer state to fit one chip's HBM; "
         "multi-chip f32-state runs are what the mesh configs are for.",
         "- The BASELINE.md north star (>=40% MFU for 1B FSDP on v5e-16) is "

@@ -40,68 +40,28 @@ VARIANTS = {
 def run_variant(name: str, overrides: dict, *, windows: int,
                 window_steps: int, batch_size: int = 8,
                 seq_len: int = 1024, preset: str = "gpt2",
-                param_dtype: str = "float32") -> dict:
-    import jax
-    import numpy as np
+                param_dtype: str = "float32",
+                fused_head_ce: bool = False) -> dict:
+    """Delegates to bench_suite.measure_row so the A/B tool and the suite
+    share ONE measurement pipeline (config construction, warmup, fenced
+    windows, MFU formula) — variant knobs ride row["cfg_overrides"]."""
+    from bench_suite import measure_row
 
-    from pytorch_distributed_tpu.config import TrainConfig, model_config
-    from pytorch_distributed_tpu.models import get_model
-    from pytorch_distributed_tpu.train.optim import make_optimizer
-    from pytorch_distributed_tpu.train.state import init_train_state
-    from pytorch_distributed_tpu.train.trainer import make_train_step
-    from pytorch_distributed_tpu.utils.prng import domain_key
-
-    seed = int.from_bytes(os.urandom(4), "little")
-    base = dict(
-        attention_impl="flash", remat="names", logits_dtype="bfloat16",
-        attn_pdrop=0.0, resid_pdrop=0.0, embd_pdrop=0.0,
+    row = dict(
+        preset=preset,
+        batch=batch_size,
+        seq_len=seq_len,
+        param_dtype=param_dtype,
+        fused_head_ce=fused_head_ce,
+        cfg_overrides=overrides,
     )
-    base.update(overrides)
-    cfg = model_config(
-        preset, dtype="bfloat16", param_dtype=param_dtype
-    ).replace(n_ctx=seq_len, **base)
-    model = get_model(cfg)
-    tcfg = TrainConfig(
-        global_batch_size=batch_size, micro_batch_size=batch_size,
-        num_steps=3 + windows * window_steps, learning_rate=3e-4,
-    )
-    tx = make_optimizer(tcfg)
-    params = model.init(domain_key(seed, "init"), cfg)
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
-    state = init_train_state(params, tx)
-    step = make_train_step(model, cfg, tx)
-    rng = np.random.default_rng(seed)
-    batch = {
-        k: jax.numpy.asarray(
-            rng.integers(0, cfg.vocab_size, (1, batch_size, seq_len)),
-            dtype=jax.numpy.int32,
-        )
-        for k in ("inputs", "targets")
-    }
-    dkey = domain_key(seed, "dropout")
-    idx = 0
-    for _ in range(3):
-        state, m = step(state, batch, jax.random.fold_in(dkey, idx))
-        idx += 1
-    float(jax.device_get(m["loss"]))
-
-    tps = []
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for _ in range(window_steps):
-            state, m = step(state, batch, jax.random.fold_in(dkey, idx))
-            idx += 1
-        float(jax.device_get(m["loss"]))
-        tps.append(window_steps * batch_size * seq_len /
-                   (time.perf_counter() - t0))
-    tok_s = statistics.median(tps)
-    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * seq_len
+    res = measure_row(row, windows=windows, window_steps=window_steps)
     return dict(
         variant=name,
-        tokens_per_sec=round(tok_s, 1),
-        ms_per_step=round(batch_size * seq_len / tok_s * 1e3, 2),
-        mfu_pct=round(tok_s * flops_per_token / 197e12 * 100, 2),
-        window_spread=round(max(tps) / min(tps), 3),
+        tokens_per_sec=res["tokens_per_sec_per_chip"],
+        ms_per_step=res["ms_per_step"],
+        mfu_pct=res["mfu_pct"],
+        window_spread=res["window_spread"],
     )
 
 
@@ -114,6 +74,7 @@ def main() -> None:
     ap.add_argument("--param-dtype", default="float32")
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--seq-len", type=int, default=1024)
+    ap.add_argument("--fused-head-ce", action="store_true")
     args = ap.parse_args()
     for name in args.variants.split(","):
         res = run_variant(
@@ -121,6 +82,7 @@ def main() -> None:
             window_steps=args.window_steps, batch_size=args.batch_size,
             seq_len=args.seq_len, preset=args.preset,
             param_dtype=args.param_dtype,
+            fused_head_ce=args.fused_head_ce,
         )
         print(json.dumps(res), flush=True)
 
