@@ -361,7 +361,8 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
 def run_blocks(
     blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
     return_aux: bool = False, tensor_axis: str | None = None,
-    expert_axis: str | None = None,
+    expert_axis: str | None = None, dropout_key: jax.Array | None = None,
+    deterministic: bool = True, layer_offset=0,
 ):
     """Scan a stack of [L_local, ...] block params over x (L_local may be a
     pipeline stage's slice of the full depth). With ``return_aux=True``
@@ -377,15 +378,31 @@ def run_blocks(
     heads/columns with tp_copy/tp_reduce at the region boundaries
     (in-stage TP for the pipeline path). ``expert_axis``: MoE expert
     weights shard over it and tokens route through the all_to_all
-    exchange (in-stage EP)."""
+    exchange (in-stage EP).
+
+    ``dropout_key``/``deterministic``/``layer_offset``: training-mode
+    dropout for the pipeline path. Per-layer keys fold exactly like
+    ``apply``'s — fold_in(dropout_key, GLOBAL layer index) — so a pipe
+    stage passing its ``layer_offset`` (stage * layers_per_stage, may be
+    traced) draws the same masks the single-device forward would."""
     from pytorch_distributed_tpu.ops.tp import pvary_missing
 
-    def body(carry, bp):
+    if not deterministic and dropout_key is None:
+        raise ValueError("training-mode run_blocks requires dropout_key")
+
+    def body(carry, xs):
         h, aux_sum = carry
+        bp, layer_idx = xs
         if block_transform is not None:
             bp = block_transform(bp)
+        layer_key = (
+            None
+            if deterministic
+            else jax.random.fold_in(dropout_key, layer_offset + layer_idx)
+        )
         h, aux = _block(
-            h, bp, cfg, None, True, None, tensor_axis, expert_axis
+            h, bp, cfg, layer_key, deterministic, None, tensor_axis,
+            expert_axis,
         )
         return (h, aux_sum + aux), None
 
@@ -393,8 +410,10 @@ def run_blocks(
         jnp.zeros((), jnp.float32),
         tuple(getattr(jax.typeof(x), "vma", frozenset())),
     )
+    n_local = jax.tree.leaves(blocks)[0].shape[0]
     (x, aux_total), _ = jax.lax.scan(
-        apply_remat(body, cfg.remat), (x, aux0), blocks
+        apply_remat(body, cfg.remat), (x, aux0),
+        (blocks, jnp.arange(n_local)),
     )
     if return_aux:
         return x, aux_total

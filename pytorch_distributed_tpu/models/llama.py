@@ -230,12 +230,16 @@ def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
 def run_blocks(
     blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
     return_aux: bool = False, tensor_axis: str | None = None,
-    expert_axis: str | None = None,
+    expert_axis: str | None = None, dropout_key: jax.Array | None = None,
+    deterministic: bool = True, layer_offset=0,
 ):
     """See models/gpt2.py run_blocks — with ``return_aux=True`` returns
     (x, aux), the local layers' summed Switch load-balancing term;
     ``tensor_axis`` runs the blocks Megatron-style on local heads/columns
-    (in-stage TP for the pipeline path)."""
+    (in-stage TP for the pipeline path). The dropout params are accepted
+    for pipeline-path API parity and ignored — the llama family is
+    dropout-free, like ``apply``."""
+    del dropout_key, deterministic, layer_offset
     from pytorch_distributed_tpu.ops.tp import pvary_missing
 
     t = x.shape[1]

@@ -37,10 +37,13 @@ single-device step does. In-stage Megatron TP over "tensor" (classic 3D
 parallelism): block params shard head-/column-aligned per
 parallel/sharding.py's rule table, blocks compute on local heads with
 the tp_copy/tp_reduce conjugates, and the norm/clip machinery psums
-tensor-sharded leaves' contributions over "tensor". Deterministic mode
-only (dropout configs are rejected at build time, like the ring/TP
-paths). seq composition inside a stage is future work, rejected
-explicitly.
+tensor-sharded leaves' contributions over "tensor". Dropout trains too:
+per-microbatch keys fold exactly like the single-device step's (fold per
+accum index, split off the embd key, fold per GLOBAL layer id), so
+pipe-only meshes reproduce its masks BITWISE; batch-sharded meshes draw
+per-shard masks from the replicated key (the explicit path's convention
+— statistically fine, not bitwise vs single device). seq composition
+inside a stage is future work, rejected explicitly.
 
 Typed under check_vma: block params vary over "pipe" (sharded), replicated
 leaves (embeddings, final norm, head) are pvaried for local differentiation
@@ -201,13 +204,27 @@ def make_pipeline_train_step(
             "(in-stage seq sharding is future work)"
         )
     strategy = mesh_cfg.strategy
-    if (
+    # The llama family is dropout-free BY DESIGN (its apply()/run_blocks
+    # ignore dropout keys entirely); the pipeline's orchestration-level
+    # embedding dropout must match that, or a llama config with nonzero
+    # pdrop fields would train a noised model the single-device step never
+    # sees. gpt2 is the only family with dropout semantics.
+    train_mode = model_cfg.family == "gpt2" and (
         model_cfg.embd_pdrop > 0
         or model_cfg.attn_pdrop > 0
         or model_cfg.resid_pdrop > 0
+    )
+    if (
+        mesh_cfg.tensor > 1
+        and model_cfg.attn_pdrop > 0
+        and model_cfg.tensor_dropout != "folded"
     ):
+        # Same contract as parallel/explicit.py: attention-dropout masks
+        # act on head-sharded tensors, so in-stage TP needs the per-shard
+        # folded-key opt-in.
         raise NotImplementedError(
-            "pipeline path is deterministic-only; zero the pdrop fields"
+            "attention dropout with in-stage tensor parallelism needs "
+            "cfg.tensor_dropout='folded' (or attn_pdrop=0.0)"
         )
     if mesh_cfg.expert > 1:
         if not model_cfg.n_experts:
@@ -288,8 +305,20 @@ def make_pipeline_train_step(
         def gather_nonblock(params):
             return params
 
-    def forward_loss(params, inputs_mb, targets_mb):
+    layers_per_stage = model_cfg.n_layer // n_stages
+
+    def _mb_keys(dropout_key, mb_idx):
+        """(block_key, embd_key) for one microbatch — the SAME fold/split
+        sequence the single-device step + apply() perform (fold per accum
+        index, split off the embd key), so pipe-only meshes reproduce its
+        masks bitwise."""
+        key_mb = jax.random.fold_in(dropout_key, mb_idx)
+        return jax.random.split(key_mb)
+
+    def forward_loss(params, inputs_mb, targets_mb, dropout_key):
         """Pipelined forward over all M microbatches; mean loss."""
+        from pytorch_distributed_tpu.ops.layers import dropout as _dropout
+
         params = gather_nonblock(params)
         m = inputs_mb.shape[0]
         b, t = inputs_mb.shape[1], inputs_mb.shape[2]
@@ -299,22 +328,38 @@ def make_pipeline_train_step(
         def tick(carry, tk):
             x_buf, loss_acc = carry
             in_idx = jnp.clip(tk, 0, m - 1)
-            x_in = jax.lax.cond(
-                stage == 0,
-                lambda: model.embed(
+            # Stage s processes microbatch tk - s this tick; its dropout
+            # keys derive from that GLOBAL microbatch index (bubble ticks
+            # reuse a clipped index on garbage — loss-gated, harmless).
+            mb_idx = jnp.clip(tk - stage, 0, m - 1)
+            if train_mode:
+                key_blocks, k_embd = _mb_keys(dropout_key, mb_idx)
+            else:
+                key_blocks = k_embd = None
+
+            def embed_branch():
+                x = model.embed(
                     params,
                     jax.lax.dynamic_index_in_dim(
                         inputs_mb, in_idx, 0, keepdims=False
                     ),
                     model_cfg,
-                ),
-                lambda: x_buf,
-            )
+                )
+                if train_mode:
+                    x = _dropout(
+                        x, model_cfg.embd_pdrop, k_embd,
+                        deterministic=False,
+                    )
+                return x
+
+            x_in = jax.lax.cond(stage == 0, embed_branch, lambda: x_buf)
             if model_cfg.n_experts:
                 y, aux = model.run_blocks(
                     params["blocks"], x_in, model_cfg,
                     block_transform=gather_block, return_aux=True,
                     tensor_axis=tensor_axis, expert_axis=expert_axis,
+                    dropout_key=key_blocks, deterministic=not train_mode,
+                    layer_offset=stage * layers_per_stage,
                 )
                 # Stage s computes on microbatch tk - s; bubble ticks run
                 # on garbage whose router aux is nonzero — gate it out so
@@ -329,6 +374,8 @@ def make_pipeline_train_step(
                     params["blocks"], x_in, model_cfg,
                     block_transform=gather_block,
                     tensor_axis=tensor_axis,
+                    dropout_key=key_blocks, deterministic=not train_mode,
+                    layer_offset=stage * layers_per_stage,
                 )
                 aux_t = 0.0
             out_idx = tk - (n_stages - 1)
@@ -361,7 +408,7 @@ def make_pipeline_train_step(
 
     grad_fn = jax.value_and_grad(forward_loss)
 
-    def loss_and_grads_1f1b(vparams, inputs_mb, targets_mb):
+    def loss_and_grads_1f1b(vparams, inputs_mb, targets_mb, dropout_key):
         """Hand-scheduled 1F1B (PipeDream-flush): stage s runs F(m) at tick
         2m+s and B(m) at tick 2m+2S-1-s. F and B land on opposite tick
         parities per stage (no conflict), every producer->consumer hop is
@@ -381,13 +428,25 @@ def make_pipeline_train_step(
         n_ticks = 2 * (m + n_stages - 1)
         perm_bwd = [(i, i - 1) for i in range(1, n_stages)]
 
-        def stage_apply(params, x, tok, tgt):
+        from pytorch_distributed_tpu.ops.layers import dropout as _dropout
+
+        def stage_apply(params, x, tok, tgt, mb_idx):
             params = gather_nonblock(params)
-            x0 = jax.lax.cond(
-                stage == 0,
-                lambda: model.embed(params, tok, model_cfg),
-                lambda: x,
-            )
+            if train_mode:
+                key_blocks, k_embd = _mb_keys(dropout_key, mb_idx)
+            else:
+                key_blocks = k_embd = None
+
+            def embed_branch():
+                e = model.embed(params, tok, model_cfg)
+                if train_mode:
+                    e = _dropout(
+                        e, model_cfg.embd_pdrop, k_embd,
+                        deterministic=False,
+                    )
+                return e
+
+            x0 = jax.lax.cond(stage == 0, embed_branch, lambda: x)
             if model_cfg.n_experts:
                 # Per-stage local loss includes this stage's layers' aux
                 # term; B ticks only ever run on real microbatches (is_b
@@ -396,6 +455,8 @@ def make_pipeline_train_step(
                     params["blocks"], x0, model_cfg,
                     block_transform=gather_block, return_aux=True,
                     tensor_axis=tensor_axis, expert_axis=expert_axis,
+                    dropout_key=key_blocks, deterministic=not train_mode,
+                    layer_offset=stage * layers_per_stage,
                 )
                 aux_t = aux.astype(jnp.float32) * model_cfg.moe_aux_coef
             else:
@@ -403,6 +464,8 @@ def make_pipeline_train_step(
                     params["blocks"], x0, model_cfg,
                     block_transform=gather_block,
                     tensor_axis=tensor_axis,
+                    dropout_key=key_blocks, deterministic=not train_mode,
+                    layer_offset=stage * layers_per_stage,
                 )
                 aux_t = _vary(jnp.zeros((), jnp.float32))
             loss = jax.lax.cond(
@@ -446,7 +509,7 @@ def make_pipeline_train_step(
                 stash = jax.lax.dynamic_update_slice_in_dim(
                     stash, fwd_in[None], slot, axis=0
                 )
-                y, _ = stage_apply(vparams, fwd_in, tok_f, tgt_f)
+                y, _ = stage_apply(vparams, fwd_in, tok_f, tgt_f, m_f)
                 return y, stash
 
             y_out, stash = jax.lax.cond(
@@ -465,7 +528,7 @@ def make_pipeline_train_step(
                     stash, jnp.mod(m_b, n_stages), 0, keepdims=False
                 )
                 (y_p, loss_p), vjp = jax.vjp(
-                    lambda p, x: stage_apply(p, x, tok_b, tgt_b),
+                    lambda p, x: stage_apply(p, x, tok_b, tgt_b, m_b),
                     vparams, x_saved,
                 )
                 # Seed: every stage differentiates its own mean-scaled
@@ -505,15 +568,14 @@ def make_pipeline_train_step(
         return loss, gacc
 
     def step_impl(state: TrainState, batch: dict, dropout_key: jax.Array):
-        del dropout_key  # deterministic-only path
         vparams = jax.tree.map(_vary, state.params)
         if schedule == "1f1b":
             loss, grads = loss_and_grads_1f1b(
-                vparams, batch["inputs"], batch["targets"]
+                vparams, batch["inputs"], batch["targets"], dropout_key
             )
         else:
             loss, grads = grad_fn(
-                vparams, batch["inputs"], batch["targets"]
+                vparams, batch["inputs"], batch["targets"], dropout_key
             )
 
         # Replicated leaves hold disjoint per-stage partials — psum over

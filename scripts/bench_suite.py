@@ -122,17 +122,21 @@ def measure_row(row: dict, *, windows: int, window_steps: int) -> dict:
 
     seed = int.from_bytes(os.urandom(4), "little")
     B, T = row["batch"], row.get("seq_len", 1024)
-    cfg = model_config(
-        row["preset"], dtype="bfloat16", param_dtype=row["param_dtype"]
-    ).replace(
+    # cfg_overrides (perf_ab variants) may override ANY key below —
+    # merge into one kwargs dict so e.g. {"remat": "dots"} replaces the
+    # row default instead of colliding with it.
+    cfg_kwargs = dict(
         attention_impl="flash",
         remat=row.get("remat", "names"),
         logits_dtype="bfloat16",
         embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
         n_ctx=T,  # benchmark sequence length (llama presets default 8192)
         fused_head_ce=row.get("fused_head_ce", False),
-        **row.get("cfg_overrides", {}),
     )
+    cfg_kwargs.update(row.get("cfg_overrides", {}))
+    cfg = model_config(
+        row["preset"], dtype="bfloat16", param_dtype=row["param_dtype"]
+    ).replace(**cfg_kwargs)
     model = get_model(cfg)
     tcfg = TrainConfig(
         global_batch_size=B, micro_batch_size=B,

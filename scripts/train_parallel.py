@@ -88,7 +88,8 @@ def main() -> int:
     )
     p.add_argument(
         "--no-dropout", action="store_true",
-        help="zero all dropout (required for seq/pipeline paths)",
+        help="zero all dropout (forced for seq>1; ring attention has no "
+             "dropout support)",
     )
     args = p.parse_args()
     setup_platform(args)
@@ -136,7 +137,9 @@ def main() -> int:
                 "never calls the CP kernels)"
             )
         model_cfg = model_cfg.replace(seq_impl=args.seq_impl)
-    if args.no_dropout or mesh_cfg.seq > 1 or args.path == "pipeline":
+    if args.no_dropout or mesh_cfg.seq > 1:
+        # seq still requires it (ring attention has no dropout support);
+        # the pipeline path trains with dropout since round 4.
         model_cfg = model_cfg.replace(
             embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0
         )

@@ -586,3 +586,100 @@ def test_pipeline_expert_requires_moe_model(eight_devices):
     mesh = make_mesh(mcfg)
     with pytest.raises(ValueError, match="n_experts"):
         make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state)
+
+
+# -- dropout on the pipeline path (round-4 extension) ----------------------
+
+
+@pytest.mark.parametrize("pipe,schedule", [(2, "gpipe"), (4, "gpipe"),
+                                           (2, "1f1b")])
+def test_pipeline_dropout_matches_single_device(
+    eight_devices, pipe, schedule
+):
+    """Training-mode dropout under pipeline parallelism: per-microbatch
+    keys fold exactly like the single-device step's (fold per accum index,
+    split off the embd key, fold per GLOBAL layer id), so on a pipe-only
+    mesh the masks — and therefore the whole training step — reproduce the
+    single-device result."""
+    cfg = ModelConfig(
+        vocab_size=128, n_ctx=16, n_embd=64, n_layer=4, n_head=4,
+        dtype="float32", embd_pdrop=0.1, attn_pdrop=0.1, resid_pdrop=0.1,
+    )
+    tcfg = TrainConfig(
+        global_batch_size=24, micro_batch_size=8, num_steps=1,
+        learning_rate=1e-3,
+    )
+    model = get_model(cfg)
+    tx = make_optimizer(tcfg)
+    rng = np.random.default_rng(0)
+    batch = {  # M=3 microbatches of [8, 16]
+        "inputs": rng.integers(0, 128, (3, 8, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (3, 8, 16)).astype(np.int32),
+    }
+    state0 = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    ref_state, ref_metrics = make_train_step(model, cfg, tx, donate=False)(
+        state0, batch, jax.random.key(7)
+    )
+
+    mcfg = MeshConfig(
+        pipe=pipe, strategy="no_shard", pipe_schedule=schedule
+    )
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(
+        model, cfg, tx, mesh, mcfg, state, schedule=schedule
+    )
+    new_state, metrics = step(state, batch, jax.random.key(7))
+    assert float(metrics["loss"]) == pytest.approx(
+        float(ref_metrics["loss"]), abs=1e-5
+    )
+    assert float(metrics["grad_norm"]) == pytest.approx(
+        float(ref_metrics["grad_norm"]), abs=1e-4
+    )
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(ref_state.params)),
+        jax.tree.leaves(jax.device_get(new_state.params)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_pipeline_dropout_batch_sharded_runs(eight_devices):
+    """With batch-sharding axes, each shard draws its local rows' masks
+    from the replicated key (the explicit path's convention) — not bitwise
+    vs single device, but the step runs and the dropout provably engages
+    (loss differs from the deterministic config)."""
+    cfg = ModelConfig(
+        vocab_size=128, n_ctx=16, n_embd=64, n_layer=4, n_head=4,
+        dtype="float32", embd_pdrop=0.2, attn_pdrop=0.0, resid_pdrop=0.2,
+    )
+    tcfg = TrainConfig(
+        global_batch_size=24, micro_batch_size=8, num_steps=1,
+        learning_rate=1e-3,
+    )
+    model = get_model(cfg)
+    tx = make_optimizer(tcfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "inputs": rng.integers(0, 128, (3, 8, 16)).astype(np.int32),
+        "targets": rng.integers(0, 128, (3, 8, 16)).astype(np.int32),
+    }
+    mcfg = MeshConfig(pipe=2, data=2, fsdp=2, strategy="full_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state)
+    _, m = step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+    det_cfg = cfg.replace(embd_pdrop=0.0, resid_pdrop=0.0)
+    det_model = get_model(det_cfg)
+    dstate = init_train_state(
+        det_model.init(domain_key(42, "init"), det_cfg), tx
+    )
+    dstate, _ = shard_pipeline_state(dstate, mesh, mcfg)
+    dstep = make_pipeline_train_step(
+        det_model, det_cfg, tx, mesh, mcfg, dstate
+    )
+    _, dm = dstep(dstate, batch, jax.random.key(0))
+    assert abs(float(m["loss"]) - float(dm["loss"])) > 1e-4
