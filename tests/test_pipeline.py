@@ -403,26 +403,6 @@ def test_pipeline_moe_matches_single_device(
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
-def test_pipeline_rejects_expert_axis(eight_devices):
-    """The expert mesh axis is still an explicit hole on the pipeline path
-    (experts run replicated within each stage)."""
-    cfg = ModelConfig(
-        vocab_size=128, n_ctx=16, n_embd=64, n_layer=4, n_head=4,
-        dtype="float32", embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
-        n_experts=4,
-    )
-    model = get_model(cfg)
-    tcfg = TrainConfig(
-        global_batch_size=8, micro_batch_size=4, num_steps=1
-    )
-    tx = make_optimizer(tcfg)
-    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
-    mcfg = MeshConfig(pipe=2, expert=2, strategy="no_shard")
-    mesh = make_mesh(mcfg)
-    with pytest.raises(NotImplementedError, match="expert"):
-        make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state)
-
-
 def test_pipeline_rejects_unknown_schedule(setup):
     cfg, model, tx = setup["cfg"], setup["model"], setup["tx"]
     mcfg = MeshConfig(pipe=2, strategy="no_shard")
