@@ -22,6 +22,11 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# The split pipeline files keep their parity asserts in this shared helper
+# module; without registration pytest would not rewrite its asserts and
+# failures would lose their operand values.
+pytest.register_assert_rewrite("_pipeline_common")
+
 from pytorch_distributed_tpu.config import ModelConfig  # noqa: E402
 
 

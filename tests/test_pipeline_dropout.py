@@ -1,0 +1,86 @@
+"""Dropout on the pipeline path.
+
+Split from test_pipeline.py (VERDICT r4 weak #4) so each full-tier chunk
+fits one command window.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from _pipeline_common import assert_matches_ref, build_case
+from pytorch_distributed_tpu.config import MeshConfig
+from pytorch_distributed_tpu.parallel import make_mesh
+from pytorch_distributed_tpu.parallel.pipeline import (
+    make_pipeline_train_step,
+    shard_pipeline_state,
+)
+from pytorch_distributed_tpu.train.state import init_train_state
+from pytorch_distributed_tpu.utils.prng import domain_key
+
+pytestmark = pytest.mark.full
+
+
+@pytest.mark.parametrize("pipe,schedule", [(2, "gpipe"), (4, "gpipe"),
+                                           (2, "1f1b")])
+def test_pipeline_dropout_matches_single_device(
+    eight_devices, pipe, schedule
+):
+    """Training-mode dropout under pipeline parallelism: per-microbatch
+    keys fold exactly like the single-device step's (fold per accum index,
+    split off the embd key, fold per GLOBAL layer id), so on a pipe-only
+    mesh the masks — and therefore the whole training step — reproduce the
+    single-device result."""
+    case = build_case(
+        "gpt2", key=7, embd_pdrop=0.1, attn_pdrop=0.1, resid_pdrop=0.1,
+    )
+    cfg, model, tx, batch = (
+        case["cfg"], case["model"], case["tx"], case["batch"]
+    )
+    mcfg = MeshConfig(
+        pipe=pipe, strategy="no_shard", pipe_schedule=schedule
+    )
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(
+        model, cfg, tx, mesh, mcfg, state, schedule=schedule
+    )
+    new_state, metrics = step(state, batch, jax.random.key(7))
+    assert_matches_ref(case, new_state, metrics)
+
+
+def test_pipeline_dropout_batch_sharded_runs(eight_devices):
+    """With batch-sharding axes, each shard draws its local rows' masks
+    from the replicated key (the explicit path's convention) — not bitwise
+    vs single device, but the step runs and the dropout provably engages
+    (loss differs from the deterministic config)."""
+    case = build_case(
+        "gpt2", with_ref=False, embd_pdrop=0.2, resid_pdrop=0.2,
+    )
+    cfg, model, tx, batch = (
+        case["cfg"], case["model"], case["tx"], case["batch"]
+    )
+    mcfg = MeshConfig(pipe=2, data=2, fsdp=2, strategy="full_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state)
+    _, m = step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+    det_cfg = cfg.replace(embd_pdrop=0.0, resid_pdrop=0.0)
+    from pytorch_distributed_tpu.models import get_model
+
+    det_model = get_model(det_cfg)
+    dstate = init_train_state(
+        det_model.init(domain_key(42, "init"), det_cfg), tx
+    )
+    dstate, _ = shard_pipeline_state(dstate, mesh, mcfg)
+    dstep = make_pipeline_train_step(
+        det_model, det_cfg, tx, mesh, mcfg, dstate
+    )
+    _, dm = dstep(dstate, batch, jax.random.key(0))
+    assert abs(float(m["loss"]) - float(dm["loss"])) > 1e-4
