@@ -69,9 +69,10 @@ class ModelConfig:
     # (ops/losses.linear_cross_entropy): logits are produced and consumed in
     # vocab blocks, so the [B, T, V] logits tensor never exists — the
     # largest activation in the step (823 MB bf16 at GPT-2 bench shapes,
-    # 2.1 GB at llama-3 vocabulary). Training-loop path only (trainer /
-    # pjit); apply() still returns logits, and the explicit/pipeline
-    # teaching paths keep the materialised head.
+    # 2.1 GB at llama-3 vocabulary). Honored by EVERY training path:
+    # trainer/pjit, explicit (shard_map), and pipeline (the fusion lands on
+    # the last stage, which owns the head). apply() itself still returns
+    # logits unless called with return_hidden=True.
     fused_head_ce: bool = False
 
     # Selective activation checkpointing per block (reference my_gpt2.py:145,

@@ -21,6 +21,9 @@ class ModelApi(NamedTuple):
     # matrix the fused head+CE loss multiplies against — tied wte [V, E]
     # ("ve") for gpt2, untied lm_head [E, V] ("ev") for llama.
     head_weight: Callable[[dict], tuple[jax.Array, str]]
+    # ln_f alone — head() minus the vocab matmul; what the fused head+CE
+    # loss consumes on the pipeline path's last stage.
+    final_norm: Callable[..., jax.Array]
 
 
 def get_model(cfg: ModelConfig) -> ModelApi:
@@ -30,6 +33,7 @@ def get_model(cfg: ModelConfig) -> ModelApi:
         return ModelApi(
             gpt2.init, gpt2.apply, gpt2.embed, gpt2.run_blocks, gpt2.head,
             lambda params: (params["wte"], "ve"),
+            gpt2.final_norm,
         )
     if cfg.family == "llama":
         from pytorch_distributed_tpu.models import llama
@@ -38,5 +42,6 @@ def get_model(cfg: ModelConfig) -> ModelApi:
             llama.init, llama.apply, llama.embed, llama.run_blocks,
             llama.head,
             lambda params: (params["lm_head"], "ev"),
+            llama.final_norm,
         )
     raise KeyError(f"unknown model family {cfg.family!r}")

@@ -335,7 +335,7 @@ def apply(
         unroll=cfg.scan_unroll,
     )
     if return_hidden:
-        out = layer_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+        out = final_norm(params, x, cfg)
     else:
         out = head(params, x, cfg)
     if return_aux:
@@ -350,18 +350,36 @@ def apply(
 # dropout configs at build time).
 
 
-def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
+def embed(
+    params: Params,
+    input_ids: jax.Array,
+    cfg: ModelConfig,
+    *,
+    seq_axis: str | None = None,
+) -> jax.Array:
+    """``seq_axis``: sequence-sharded (context-parallel) call — the local
+    [B, T/N] token shard takes position rows [idx*T/N, (idx+1)*T/N) of the
+    learned table, exactly like ``apply``'s seq path."""
     b, t = input_ids.shape
-    if t > cfg.n_ctx:
-        raise ValueError(f"sequence length {t} exceeds n_ctx {cfg.n_ctx}")
-    x = params["wte"][input_ids] + params["wpe"][:t]
+    global_t = t * (jax.lax.psum(1, seq_axis) if seq_axis is not None else 1)
+    if global_t > cfg.n_ctx:
+        raise ValueError(
+            f"sequence length {global_t} exceeds n_ctx {cfg.n_ctx}"
+        )
+    if seq_axis is not None:
+        pos_start = jax.lax.axis_index(seq_axis) * t
+        wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos_start, t, axis=0)
+    else:
+        wpe = params["wpe"][:t]
+    x = params["wte"][input_ids] + wpe
     return x.astype(jnp.dtype(cfg.dtype))
 
 
 def run_blocks(
     blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
     return_aux: bool = False, tensor_axis: str | None = None,
-    expert_axis: str | None = None, dropout_key: jax.Array | None = None,
+    expert_axis: str | None = None, seq_axis: str | None = None,
+    dropout_key: jax.Array | None = None,
     deterministic: bool = True, layer_offset=0,
 ):
     """Scan a stack of [L_local, ...] block params over x (L_local may be a
@@ -379,6 +397,10 @@ def run_blocks(
     (in-stage TP for the pipeline path). ``expert_axis``: MoE expert
     weights shard over it and tokens route through the all_to_all
     exchange (in-stage EP).
+
+    ``seq_axis``: sequence-sharded (context-parallel) call — x holds the
+    local token shard and attention runs the ring/ulysses kernel over the
+    axis (in-stage seq for the pipeline path).
 
     ``dropout_key``/``deterministic``/``layer_offset``: training-mode
     dropout for the pipeline path. Per-layer keys fold exactly like
@@ -401,7 +423,7 @@ def run_blocks(
             else jax.random.fold_in(dropout_key, layer_offset + layer_idx)
         )
         h, aux = _block(
-            h, bp, cfg, layer_key, deterministic, None, tensor_axis,
+            h, bp, cfg, layer_key, deterministic, seq_axis, tensor_axis,
             expert_axis,
         )
         return (h, aux_sum + aux), None
@@ -420,8 +442,15 @@ def run_blocks(
     return x
 
 
+def final_norm(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """ln_f alone — the hidden states the fused head+CE loss consumes
+    (the pipeline path's last stage calls this instead of ``head`` when
+    cfg.fused_head_ce)."""
+    return layer_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+
+
 def head(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    x = layer_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+    x = final_norm(params, x, cfg)
     # Tied LM head (reference my_gpt2.py:200-206): logits = x @ wte^T. The MXU
     # accumulates in f32; cfg.logits_dtype controls what lands in HBM.
     logits = jnp.einsum(

@@ -209,7 +209,7 @@ def apply(
     if return_hidden:
         # Final-norm hidden states for the fused head+CE loss (see
         # models/gpt2.py apply docstring).
-        out = rms_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+        out = final_norm(params, x, cfg)
     else:
         out = head(params, x, cfg)
     if return_aux:
@@ -220,37 +220,55 @@ def apply(
 # -- phase functions (pipeline parallelism) — see models/gpt2.py -----------
 
 
-def embed(params: Params, input_ids: jax.Array, cfg: ModelConfig) -> jax.Array:
+def embed(
+    params: Params,
+    input_ids: jax.Array,
+    cfg: ModelConfig,
+    *,
+    seq_axis: str | None = None,
+) -> jax.Array:
+    """``seq_axis``: sequence-sharded call — positions are rotary (applied
+    inside run_blocks with the shard offset), so embedding is just the
+    token lookup; only the GLOBAL length check changes."""
     t = input_ids.shape[1]
-    if t > cfg.n_ctx:
-        raise ValueError(f"sequence length {t} exceeds n_ctx {cfg.n_ctx}")
+    global_t = t * (jax.lax.psum(1, seq_axis) if seq_axis is not None else 1)
+    if global_t > cfg.n_ctx:
+        raise ValueError(
+            f"sequence length {global_t} exceeds n_ctx {cfg.n_ctx}"
+        )
     return params["wte"][input_ids].astype(jnp.dtype(cfg.dtype))
 
 
 def run_blocks(
     blocks: Params, x: jax.Array, cfg: ModelConfig, *, block_transform=None,
     return_aux: bool = False, tensor_axis: str | None = None,
-    expert_axis: str | None = None, dropout_key: jax.Array | None = None,
+    expert_axis: str | None = None, seq_axis: str | None = None,
+    dropout_key: jax.Array | None = None,
     deterministic: bool = True, layer_offset=0,
 ):
     """See models/gpt2.py run_blocks — with ``return_aux=True`` returns
     (x, aux), the local layers' summed Switch load-balancing term;
     ``tensor_axis`` runs the blocks Megatron-style on local heads/columns
-    (in-stage TP for the pipeline path). The dropout params are accepted
-    for pipeline-path API parity and ignored — the llama family is
-    dropout-free, like ``apply``."""
+    (in-stage TP for the pipeline path); ``seq_axis`` runs attention
+    sequence-parallel with RoPE offset by the shard's global start
+    (in-stage seq). The dropout params are accepted for pipeline-path API
+    parity and ignored — the llama family is dropout-free, like
+    ``apply``."""
     del dropout_key, deterministic, layer_offset
     from pytorch_distributed_tpu.ops.tp import pvary_missing
 
     t = x.shape[1]
-    cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta)
+    offset = (
+        jax.lax.axis_index(seq_axis) * t if seq_axis is not None else 0
+    )
+    cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta, offset=offset)
 
     def body(carry, bp):
         h, aux_sum = carry
         if block_transform is not None:
             bp = block_transform(bp)
         h, aux = _block(
-            h, bp, cfg, cos, sin, None, tensor_axis, expert_axis
+            h, bp, cfg, cos, sin, seq_axis, tensor_axis, expert_axis
         )
         return (h, aux_sum + aux), None
 
@@ -266,8 +284,14 @@ def run_blocks(
     return x
 
 
+def final_norm(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """ln_f alone — the hidden states the fused head+CE loss consumes
+    (see models/gpt2.py final_norm)."""
+    return rms_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+
+
 def head(params: Params, x: jax.Array, cfg: ModelConfig) -> jax.Array:
-    x = rms_norm(x, params["ln_f"], eps=cfg.layer_norm_epsilon)
+    x = final_norm(params, x, cfg)
     return jnp.einsum(
         "bte,ev->btv", x, params["lm_head"].astype(x.dtype),
         preferred_element_type=jnp.float32,

@@ -25,6 +25,19 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _vary_like(z, *refs):
+    """pcast ``z`` to vary on the union of the refs' varying manual axes —
+    shard_map check_vma requires the fused-CE scans' fresh zero carries to
+    match the varying outputs their bodies produce (explicit/pipeline
+    paths call this op inside shard_map)."""
+    from pytorch_distributed_tpu.ops.tp import pvary_missing
+
+    axes: set = set()
+    for r in refs:
+        axes |= set(getattr(getattr(r, "aval", None), "vma", frozenset()))
+    return pvary_missing(z, tuple(axes))
+
+
 def cross_entropy_loss(logits: jax.Array, targets: jax.Array) -> jax.Array:
     """Mean token cross-entropy. logits [..., V] float; targets [...] int."""
     logits = logits.astype(jnp.float32)
@@ -122,10 +135,13 @@ def _linear_ce_op(block_v: int, w_layout: str, logits_dtype):
 
         (m, l, gold), _ = jax.lax.scan(
             body,
-            (
-                jnp.full((n,), NEG_INF, jnp.float32),
-                jnp.zeros((n,), jnp.float32),
-                jnp.zeros((n,), jnp.float32),
+            tuple(
+                _vary_like(z, x, wc, targets)
+                for z in (
+                    jnp.full((n,), NEG_INF, jnp.float32),
+                    jnp.zeros((n,), jnp.float32),
+                    jnp.zeros((n,), jnp.float32),
+                )
             ),
             jnp.arange(nb),
         )
@@ -175,9 +191,12 @@ def _linear_ce_op(block_v: int, w_layout: str, logits_dtype):
 
         (dx, dwp), _ = jax.lax.scan(
             body,
-            (
-                jnp.zeros(x.shape, jnp.float32),
-                jnp.zeros(wp.shape, jnp.float32),
+            tuple(
+                _vary_like(z, x, wc, targets, ct)
+                for z in (
+                    jnp.zeros(x.shape, jnp.float32),
+                    jnp.zeros(wp.shape, jnp.float32),
+                )
             ),
             jnp.arange(nb),
         )

@@ -67,9 +67,15 @@ except ImportError:  # pragma: no cover
 
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 from pytorch_distributed_tpu.models import ModelApi
-from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.ops.losses import (
+    cross_entropy_loss,
+    linear_cross_entropy,
+)
 from pytorch_distributed_tpu.ops.tp import pvary_missing
-from pytorch_distributed_tpu.parallel.mesh import batch_partition_spec
+from pytorch_distributed_tpu.parallel.mesh import (
+    batch_partition_spec,
+    fold_batch_shard_key,
+)
 from pytorch_distributed_tpu.parallel.sharding import param_partition_specs
 from pytorch_distributed_tpu.parallel.zero import (
     clip_by_global_norm_typed,
@@ -125,7 +131,13 @@ def make_explicit_train_step(
         # through the same all_to_all expert exchange (capacity counted
         # per shard, like the data axis). Equivalence-tested in
         # tests/test_moe.py.
-    if seq_axis is not None and model_cfg.attn_pdrop > 0:
+    # Dropout-rejection checks are gated on the gpt2 family: llama's
+    # apply()/run_blocks ignore dropout keys entirely (dropout-free BY
+    # DESIGN), so a hand-built llama ModelConfig — whose *_pdrop fields
+    # default nonzero — must not be spuriously rejected for seq/tensor
+    # meshes it trains identically on.
+    _gpt2 = model_cfg.family == "gpt2"
+    if _gpt2 and seq_axis is not None and model_cfg.attn_pdrop > 0:
         # Fail at build time, not mid-trace on the first step (ring attention
         # has no attention-dropout support, ops/attention.py).
         raise NotImplementedError(
@@ -133,7 +145,8 @@ def make_explicit_train_step(
             f"(attn_pdrop={model_cfg.attn_pdrop}); set attn_pdrop=0.0"
         )
     if (
-        tensor_axis is not None
+        _gpt2
+        and tensor_axis is not None
         and model_cfg.attn_pdrop > 0
         and model_cfg.tensor_dropout != "folded"
     ):
@@ -185,10 +198,10 @@ def make_explicit_train_step(
         gather_block = None
 
     def forward_loss(params_shard, inputs, targets, key):
-        if seq_axis is not None and train_mode:
-            # Independent dropout masks per sequence shard (embd/resid
-            # dropout acts on the local T/N slice).
-            key = jax.random.fold_in(key, jax.lax.axis_index(seq_axis))
+        if train_mode:
+            # Independent dropout masks per batch/sequence shard — the
+            # shared shard_map-path convention (parallel/mesh.py).
+            key = fold_batch_shard_key(key, mesh_cfg)
         if strategy == "full_shard" and fsdp_size > 1:
             # Non-block leaves (embeddings, final norm) are gathered up
             # front; each scanned layer gathers its own block just in time
@@ -203,6 +216,7 @@ def make_explicit_train_step(
             }
         else:
             params = params_shard
+        fused = model_cfg.fused_head_ce
         out = model.apply(
             params,
             inputs,
@@ -214,9 +228,26 @@ def make_explicit_train_step(
             tensor_axis=tensor_axis,
             expert_axis=expert_axis,
             return_aux=bool(model_cfg.n_experts),
+            return_hidden=fused,
         )
-        logits, aux = out if model_cfg.n_experts else (out, 0.0)
-        loss = cross_entropy_loss(logits, targets)
+        out, aux = out if model_cfg.n_experts else (out, 0.0)
+        if fused:
+            # Head matmul fused into the loss: the [B, T, V] logits tensor
+            # never exists (ops/losses.linear_cross_entropy). Under seq
+            # sharding the hidden rows are this shard's local tokens — the
+            # local-mean loss the seq pmean below averages, exactly like
+            # the unfused path; under full_shard `params` is the gathered
+            # tree, so the head weight is whole.
+            w, layout = model.head_weight(params)
+            loss = linear_cross_entropy(
+                out.reshape(-1, out.shape[-1]),
+                w,
+                targets.reshape(-1),
+                w_layout=layout,
+                logits_dtype=model_cfg.logits_dtype,
+            )
+        else:
+            loss = cross_entropy_loss(out, targets)
         if model_cfg.n_experts:
             loss = loss + model_cfg.moe_aux_coef * aux
         return loss

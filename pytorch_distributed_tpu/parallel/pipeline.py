@@ -40,10 +40,18 @@ the tp_copy/tp_reduce conjugates, and the norm/clip machinery psums
 tensor-sharded leaves' contributions over "tensor". Dropout trains too:
 per-microbatch keys fold exactly like the single-device step's (fold per
 accum index, split off the embd key, fold per GLOBAL layer id), so
-pipe-only meshes reproduce its masks BITWISE; batch-sharded meshes draw
-per-shard masks from the replicated key (the explicit path's convention
-— statistically fine, not bitwise vs single device). seq composition
-inside a stage is future work, rejected explicitly.
+pipe-only meshes reproduce its masks BITWISE; batch-sharded meshes fold
+each sharded batch axis's index into the key so every global row draws
+an INDEPENDENT mask (iid, like single-device training — the explicit
+path's convention; not bitwise vs single device). In-stage SEQUENCE
+parallelism over "seq" (PP x SP — the standard long-context large-model
+layout): the token dim of every microbatch shards over "seq", stage 0
+embeds its position slice (wpe offset / RoPE offset), attention runs
+the ring or Ulysses kernel whose collectives ride the "seq" axis —
+orthogonal to the pipeline's own "pipe" ppermute, and uniform within
+each seq ring even under 1F1B's per-stage cond gating (seq peers always
+share a stage, so they agree on every schedule predicate) — and the
+last stage's local-token loss is pmean'd over "seq" at the boundary.
 
 Typed under check_vma: block params vary over "pipe" (sharded), replicated
 leaves (embeddings, final norm, head) are pvaried for local differentiation
@@ -69,8 +77,12 @@ except ImportError:  # pragma: no cover
 
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from pytorch_distributed_tpu.models import ModelApi
-from pytorch_distributed_tpu.ops.losses import cross_entropy_loss
+from pytorch_distributed_tpu.ops.losses import (
+    cross_entropy_loss,
+    linear_cross_entropy,
+)
 from pytorch_distributed_tpu.ops.tp import pvary_missing
+from pytorch_distributed_tpu.parallel.mesh import fold_batch_shard_key
 from pytorch_distributed_tpu.parallel.zero import (
     clip_by_global_norm_typed,
     gather_params,
@@ -198,11 +210,6 @@ def make_pipeline_train_step(
             "with make_optimizer(cfg, with_clip=False) and pass "
             "grad_clip_norm= explicitly"
         )
-    if mesh_cfg.seq > 1:
-        raise NotImplementedError(
-            "pipeline composes with the data, fsdp, and tensor axes "
-            "(in-stage seq sharding is future work)"
-        )
     strategy = mesh_cfg.strategy
     # The llama family is dropout-free BY DESIGN (its apply()/run_blocks
     # ignore dropout keys entirely); the pipeline's orchestration-level
@@ -215,16 +222,27 @@ def make_pipeline_train_step(
         or model_cfg.resid_pdrop > 0
     )
     if (
-        mesh_cfg.tensor > 1
+        train_mode
+        and mesh_cfg.tensor > 1
         and model_cfg.attn_pdrop > 0
         and model_cfg.tensor_dropout != "folded"
     ):
         # Same contract as parallel/explicit.py: attention-dropout masks
         # act on head-sharded tensors, so in-stage TP needs the per-shard
-        # folded-key opt-in.
+        # folded-key opt-in. Gated on train_mode so llama configs (which
+        # ignore dropout fields entirely) are not spuriously rejected.
         raise NotImplementedError(
             "attention dropout with in-stage tensor parallelism needs "
             "cfg.tensor_dropout='folded' (or attn_pdrop=0.0)"
+        )
+    if train_mode and mesh_cfg.seq > 1 and model_cfg.attn_pdrop > 0:
+        # Ring/ulysses attention has no attention-dropout support
+        # (ops/attention.py) — same build-time contract as the explicit
+        # path's seq check.
+        raise NotImplementedError(
+            "attention dropout is not supported with in-stage sequence "
+            f"parallelism (attn_pdrop={model_cfg.attn_pdrop}); set "
+            "attn_pdrop=0.0"
         )
     if mesh_cfg.expert > 1:
         if not model_cfg.n_experts:
@@ -245,6 +263,7 @@ def make_pipeline_train_step(
     data_axis = "data" if mesh_cfg.data > 1 else None
     tensor_axis = "tensor" if mesh_cfg.tensor > 1 else None
     expert_axis = "expert" if mesh_cfg.expert > 1 else None
+    seq_axis = "seq" if mesh_cfg.seq > 1 else None
     fsdp_size = mesh_cfg.fsdp
     # No wrap-around pair: stage 0 always takes the embed branch, so shipping
     # the last stage's activation back to it would be a wasted hop; ppermute
@@ -260,17 +279,18 @@ def make_pipeline_train_step(
         ).params
     else:
         shard_param_specs = None
-    # fsdp is data parallelism with sharded state: batch rows split over it.
+    # fsdp is data parallelism with sharded state: batch rows split over it;
+    # in-stage seq (context parallelism) shards the TOKEN dim.
     batch_axes = tuple(
         ax
         for ax in ("data", "fsdp", "expert")
         if getattr(mesh_cfg, ax) > 1
     ) or None
-    batch_spec = P(None, batch_axes, None)
+    batch_spec = P(None, batch_axes, seq_axis)
 
     vary_axes = ("pipe",) + tuple(
         ax
-        for ax in ("data", "fsdp", "expert")
+        for ax in ("data", "fsdp", "expert", "seq")
         if getattr(mesh_cfg, ax) > 1
     )
 
@@ -307,13 +327,25 @@ def make_pipeline_train_step(
 
     layers_per_stage = model_cfg.n_layer // n_stages
 
-    def _mb_keys(dropout_key, mb_idx):
-        """(block_key, embd_key) for one microbatch — the SAME fold/split
-        sequence the single-device step + apply() perform (fold per accum
-        index, split off the embd key), so pipe-only meshes reproduce its
-        masks bitwise."""
-        key_mb = jax.random.fold_in(dropout_key, mb_idx)
-        return jax.random.split(key_mb)
+    def head_loss(params, y, targets):
+        """Last-stage CE. With cfg.fused_head_ce the head matmul is fused
+        into the loss (ops/losses.linear_cross_entropy) — the pipeline's
+        last stage is exactly where the unfused [B, T, V] logits would be
+        the step's largest activation (2.1 GB bf16 at llama-3 vocab)."""
+        if model_cfg.fused_head_ce:
+            hidden = model.final_norm(params, y, model_cfg)
+            w, layout = model.head_weight(params)
+            return linear_cross_entropy(
+                hidden.reshape(-1, hidden.shape[-1]),
+                w,
+                targets.reshape(-1),
+                w_layout=layout,
+                logits_dtype=model_cfg.logits_dtype,
+            )
+        return cross_entropy_loss(
+            model.head(params, y, model_cfg), targets
+        )
+
 
     def forward_loss(params, inputs_mb, targets_mb, dropout_key):
         """Pipelined forward over all M microbatches; mean loss."""
@@ -324,6 +356,8 @@ def make_pipeline_train_step(
         b, t = inputs_mb.shape[1], inputs_mb.shape[2]
         stage = jax.lax.axis_index("pipe")
         n_ticks = m + n_stages - 1
+        if train_mode:
+            dropout_key = fold_batch_shard_key(dropout_key, mesh_cfg)
 
         def tick(carry, tk):
             x_buf, loss_acc = carry
@@ -333,7 +367,7 @@ def make_pipeline_train_step(
             # reuse a clipped index on garbage — loss-gated, harmless).
             mb_idx = jnp.clip(tk - stage, 0, m - 1)
             if train_mode:
-                key_blocks, k_embd = _mb_keys(dropout_key, mb_idx)
+                key_blocks, k_embd = microbatch_keys(dropout_key, mb_idx)
             else:
                 key_blocks = k_embd = None
 
@@ -344,6 +378,7 @@ def make_pipeline_train_step(
                         inputs_mb, in_idx, 0, keepdims=False
                     ),
                     model_cfg,
+                    seq_axis=seq_axis,
                 )
                 if train_mode:
                     x = _dropout(
@@ -358,6 +393,7 @@ def make_pipeline_train_step(
                     params["blocks"], x_in, model_cfg,
                     block_transform=gather_block, return_aux=True,
                     tensor_axis=tensor_axis, expert_axis=expert_axis,
+                    seq_axis=seq_axis,
                     dropout_key=key_blocks, deterministic=not train_mode,
                     layer_offset=stage * layers_per_stage,
                 )
@@ -373,7 +409,7 @@ def make_pipeline_train_step(
                 y = model.run_blocks(
                     params["blocks"], x_in, model_cfg,
                     block_transform=gather_block,
-                    tensor_axis=tensor_axis,
+                    tensor_axis=tensor_axis, seq_axis=seq_axis,
                     dropout_key=key_blocks, deterministic=not train_mode,
                     layer_offset=stage * layers_per_stage,
                 )
@@ -382,8 +418,8 @@ def make_pipeline_train_step(
             valid_out = (stage == n_stages - 1) & (out_idx >= 0)
             loss_t = jax.lax.cond(
                 valid_out,
-                lambda: cross_entropy_loss(
-                    model.head(params, y, model_cfg),
+                lambda: head_loss(
+                    params, y,
                     jax.lax.dynamic_index_in_dim(
                         targets_mb, jnp.clip(out_idx, 0, m - 1), 0,
                         keepdims=False,
@@ -427,18 +463,20 @@ def make_pipeline_train_step(
         stage = jax.lax.axis_index("pipe")
         n_ticks = 2 * (m + n_stages - 1)
         perm_bwd = [(i, i - 1) for i in range(1, n_stages)]
+        if train_mode:
+            dropout_key = fold_batch_shard_key(dropout_key, mesh_cfg)
 
         from pytorch_distributed_tpu.ops.layers import dropout as _dropout
 
         def stage_apply(params, x, tok, tgt, mb_idx):
             params = gather_nonblock(params)
             if train_mode:
-                key_blocks, k_embd = _mb_keys(dropout_key, mb_idx)
+                key_blocks, k_embd = microbatch_keys(dropout_key, mb_idx)
             else:
                 key_blocks = k_embd = None
 
             def embed_branch():
-                e = model.embed(params, tok, model_cfg)
+                e = model.embed(params, tok, model_cfg, seq_axis=seq_axis)
                 if train_mode:
                     e = _dropout(
                         e, model_cfg.embd_pdrop, k_embd,
@@ -455,6 +493,7 @@ def make_pipeline_train_step(
                     params["blocks"], x0, model_cfg,
                     block_transform=gather_block, return_aux=True,
                     tensor_axis=tensor_axis, expert_axis=expert_axis,
+                    seq_axis=seq_axis,
                     dropout_key=key_blocks, deterministic=not train_mode,
                     layer_offset=stage * layers_per_stage,
                 )
@@ -463,16 +502,14 @@ def make_pipeline_train_step(
                 y = model.run_blocks(
                     params["blocks"], x0, model_cfg,
                     block_transform=gather_block,
-                    tensor_axis=tensor_axis,
+                    tensor_axis=tensor_axis, seq_axis=seq_axis,
                     dropout_key=key_blocks, deterministic=not train_mode,
                     layer_offset=stage * layers_per_stage,
                 )
                 aux_t = _vary(jnp.zeros((), jnp.float32))
             loss = jax.lax.cond(
                 stage == n_stages - 1,
-                lambda: cross_entropy_loss(
-                    model.head(params, y, model_cfg), tgt
-                ),
+                lambda: head_loss(params, y, tgt),
                 lambda: _vary(jnp.zeros((), jnp.float32)),
             )
             return y, loss + aux_t
@@ -512,9 +549,24 @@ def make_pipeline_train_step(
                 y, _ = stage_apply(vparams, fwd_in, tok_f, tgt_f, m_f)
                 return y, stash
 
-            y_out, stash = jax.lax.cond(
-                is_f, do_f, lambda st: (zero_act, st), stash
-            )
+            if seq_axis is None:
+                y_out, stash = jax.lax.cond(
+                    is_f, do_f, lambda st: (zero_act, st), stash
+                )
+            else:
+                # Ring/ulysses collectives ride the "seq" axis, but
+                # lax.ppermute lowers to a collective whose rendezvous
+                # spans EVERY device — gating it behind a cond on the
+                # pipe-varying schedule predicate deadlocks (or pairs
+                # mismatched hops and exchanges garbage). With a seq axis
+                # the stage body therefore runs UNCONDITIONALLY — every
+                # device executes the same collective sequence every tick
+                # — and the schedule gates the RESULTS: bubble ticks
+                # compute on garbage that is discarded, exactly like the
+                # GPipe loss gate.
+                y_all, stash_all = do_f(stash)
+                y_out = jnp.where(is_f, y_all, zero_act)
+                stash = jnp.where(is_f, stash_all, stash)
 
             # ---- backward op: B(s, m_b) at tk == 2*m_b + 2S-1 - s --------
             mb2 = tk - (2 * n_stages - 1 - stage)
@@ -542,13 +594,24 @@ def make_pipeline_train_step(
                 dp, dx = vjp((dy.astype(y_p.dtype), _vary(dl)))
                 return dp, dx.astype(dt), loss_p
 
-            dp, dx_out, loss_p = jax.lax.cond(
-                is_b,
-                do_b,
-                lambda ops: (zero_grads, zero_act,
-                             _vary(jnp.zeros((), jnp.float32))),
-                (bwd_in, stash),
-            )
+            if seq_axis is None:
+                dp, dx_out, loss_p = jax.lax.cond(
+                    is_b,
+                    do_b,
+                    lambda ops: (zero_grads, zero_act,
+                                 _vary(jnp.zeros((), jnp.float32))),
+                    (bwd_in, stash),
+                )
+            else:
+                # Same uniform-collective contract as the forward op.
+                dp_all, dx_all, loss_all = do_b((bwd_in, stash))
+                dp = jax.tree.map(
+                    lambda a, z: jnp.where(is_b, a, z), dp_all, zero_grads
+                )
+                dx_out = jnp.where(is_b, dx_all, zero_act)
+                loss_p = jnp.where(
+                    is_b, loss_all, _vary(jnp.zeros((), jnp.float32))
+                )
             gacc = jax.tree.map(jnp.add, gacc, dp)
             lacc = lacc + loss_p
 
@@ -625,6 +688,13 @@ def make_pipeline_train_step(
                 # ZeRO-1 / no_shard: plain DDP all-reduce(AVG) over fsdp.
                 grads = jax.lax.pmean(grads, "fsdp")
             loss = jax.lax.pmean(loss, "fsdp")
+        if seq_axis is not None:
+            # Context parallelism: params are replicated over seq; each
+            # shard computed grads of its local-token mean loss — the
+            # global mean of both is the seq-average (same convention as
+            # parallel/explicit.py).
+            grads = jax.lax.pmean(grads, seq_axis)
+            loss = jax.lax.pmean(loss, seq_axis)
         if data_axis:
             grads = jax.lax.pmean(grads, data_axis)
             loss = jax.lax.pmean(loss, data_axis)
@@ -703,3 +773,12 @@ def make_pipeline_train_step(
 
 def _has_pipe(spec: P) -> bool:
     return _has_axis(spec, "pipe")
+
+
+def microbatch_keys(dropout_key: jax.Array, mb_idx):
+    """(block_key, embd_key) for one microbatch — the SAME fold/split
+    sequence the single-device step + apply() perform (fold per accum
+    index, split off the embd key), so pipe-only meshes reproduce its
+    masks bitwise."""
+    key_mb = jax.random.fold_in(dropout_key, mb_idx)
+    return jax.random.split(key_mb)

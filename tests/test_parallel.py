@@ -193,6 +193,33 @@ def test_explicit_tensor_seq_composition(
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_llama_default_pdrops_accepted_on_tp_and_seq_meshes(eight_devices):
+    """A hand-built llama ModelConfig keeps the gpt2-default nonzero
+    *_pdrop fields, but the family ignores dropout entirely — the
+    explicit path's TP/seq attention-dropout rejections must not fire
+    for it (round-4 advisor finding)."""
+    cfg = ModelConfig(
+        family="llama", vocab_size=128, n_ctx=16, n_embd=64, n_layer=2,
+        n_head=4, n_kv_head=2, n_inner=128, activation_function="silu",
+        dtype="float32",
+    )
+    assert cfg.attn_pdrop > 0  # the default that used to trip the check
+    model = get_model(cfg)
+    tcfg = TrainConfig(
+        global_batch_size=16, micro_batch_size=16, num_steps=1,
+    )
+    tx = make_optimizer(tcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    for mcfg in (
+        MeshConfig(tensor=2, strategy="no_shard"),
+        MeshConfig(seq=2, strategy="no_shard"),
+    ):
+        mesh = make_mesh(mcfg)
+        sharded, _ = shard_train_state(state, mesh, mcfg)
+        # Build-time acceptance is the contract under test; no step run.
+        make_explicit_train_step(model, cfg, tx, mesh, mcfg, sharded)
+
+
 def test_explicit_tp_attn_dropout_rejected(setup):
     cfg, tx, model = setup["cfg"], setup["tx"], setup["model"]
     mcfg = MeshConfig(tensor=4, strategy="no_shard")

@@ -60,6 +60,28 @@ def test_pipeline_rejects_bad_configs(setup):
         )
 
 
+def test_pipeline_llama_default_pdrops_accepted_on_tp_mesh(eight_devices):
+    """A hand-built llama ModelConfig keeps nonzero *_pdrop defaults but
+    the family ignores dropout — the pipeline's in-stage-TP attention-
+    dropout rejection must not fire for it (round-4 advisor finding)."""
+    from _pipeline_common import build_case
+
+    case = build_case("llama", with_ref=False)
+    cfg = case["cfg"].replace(
+        embd_pdrop=0.1, attn_pdrop=0.1, resid_pdrop=0.1
+    )
+    from pytorch_distributed_tpu.models import get_model
+
+    model = get_model(cfg)
+    state = init_train_state(
+        model.init(domain_key(42, "init"), cfg), case["tx"]
+    )
+    mcfg = MeshConfig(pipe=2, tensor=2, strategy="no_shard")
+    mesh = make_mesh(mcfg)
+    # Build-time acceptance is the contract under test; no step run.
+    make_pipeline_train_step(model, cfg, case["tx"], mesh, mcfg, state)
+
+
 def test_pipeline_zero2_shards_opt_state_not_params(setup):
     """Under pipe x shard_grad_op the optimizer moments shard over fsdp
     while params stay replicated over it (ZeRO-2's defining memory shape)."""

@@ -1,18 +1,33 @@
-"""Pipeline x in-stage sequence/context parallelism.
+"""Pipeline x in-stage sequence/context parallelism (PP x SP).
 
-Currently pins the live build-time rejection (parallel/pipeline.py); the
-equivalence tests land with the in-stage seq composition (VERDICT r4 #1).
+The last composition-matrix hole, closed in round 5 (VERDICT r4 #1): the
+token dim of every microbatch shards over "seq" inside each pipeline
+stage, attention runs the ring (or Ulysses) kernel over that axis, and
+the composed step must reproduce the single-device accumulated step.
+
+The 1F1B schedule is the delicate case: lax.ppermute lowers to a
+collective whose rendezvous spans every device, so the ring cannot sit
+behind the schedule's per-stage cond gates — with a seq axis the stage
+bodies run unconditionally and the schedule gates results via selects
+(see parallel/pipeline.py). These tests pin that contract for both
+schedules.
 """
 
 from __future__ import annotations
 
+import jax
 import pytest
 
-from _pipeline_common import build_case
+from _pipeline_common import (  # noqa: F401  (setup is a fixture)
+    assert_matches_ref,
+    build_case,
+    setup,
+)
 from pytorch_distributed_tpu.config import MeshConfig
 from pytorch_distributed_tpu.parallel import make_mesh
 from pytorch_distributed_tpu.parallel.pipeline import (
     make_pipeline_train_step,
+    shard_pipeline_state,
 )
 from pytorch_distributed_tpu.train.state import init_train_state
 from pytorch_distributed_tpu.utils.prng import domain_key
@@ -20,11 +35,114 @@ from pytorch_distributed_tpu.utils.prng import domain_key
 pytestmark = pytest.mark.full
 
 
-def test_pipeline_rejects_seq_axis(eight_devices):
-    case = build_case("gpt2", with_ref=False)
+def _run_pipeline(case, mcfg, schedule="gpipe"):
+    cfg, model, tx = case["cfg"], case["model"], case["tx"]
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(
+        model, cfg, tx, mesh, mcfg, state, schedule=schedule
+    )
+    return step(state, case["batch"], jax.random.key(0))
+
+
+@pytest.mark.parametrize(
+    "pipe,seq,data,fsdp,strategy,schedule",
+    [
+        (2, 2, 1, 1, "no_shard", "gpipe"),
+        (2, 4, 1, 1, "no_shard", "gpipe"),
+        (2, 2, 2, 1, "no_shard", "gpipe"),
+        (2, 2, 1, 2, "full_shard", "gpipe"),   # PP x SP x ZeRO-3
+        (2, 2, 1, 1, "no_shard", "1f1b"),
+        (2, 2, 2, 1, "no_shard", "1f1b"),
+    ],
+)
+def test_pipeline_seq_matches_single_device(
+    setup, pipe, seq, data, fsdp, strategy, schedule
+):
+    """Ring attention inside a pipeline stage: loss / grad-norm / updated
+    params match the single-device accumulated step for both schedules,
+    composed with data sharding and in-stage ZeRO-3."""
+    mcfg = MeshConfig(
+        pipe=pipe, seq=seq, data=data, fsdp=fsdp, strategy=strategy,
+        pipe_schedule=schedule,
+    )
+    new_state, metrics = _run_pipeline(setup, mcfg, schedule)
+    assert_matches_ref(setup, new_state, metrics)
+
+
+def test_pipeline_seq_ulysses_matches_single_device(setup):
+    """The Ulysses (head/sequence all-to-all) context-parallel technique
+    also composes in-stage: cfg.seq_impl picks it, and all_to_all lowers
+    with replica subgroups so both schedules' gating is safe."""
+    case = dict(setup)
+    case["cfg"] = setup["cfg"].replace(
+        seq_impl="ulysses", attention_impl="flash"
+    )
+    from pytorch_distributed_tpu.models import get_model
+
+    case["model"] = get_model(case["cfg"])
+    mcfg = MeshConfig(pipe=2, seq=2, strategy="no_shard")
+    new_state, metrics = _run_pipeline(case, mcfg)
+    assert_matches_ref(setup, new_state, metrics)
+
+
+def test_pipeline_seq_expert_matches_single_device(eight_devices):
+    """PP x SP x EP: seq shards each stage's tokens, the MoE layers route
+    the LOCAL tokens through the expert all_to_all (capacity counted per
+    shard), and parity holds with aux_coef=0 (the per-shard-aux
+    convention, test_moe.py)."""
+    case = build_case(
+        "gpt2",
+        n_experts=4, expert_capacity_factor=8.0, moe_aux_coef=0.0,
+    )
+    mcfg = MeshConfig(pipe=2, seq=2, expert=2, strategy="no_shard")
+    new_state, metrics = _run_pipeline(case, mcfg)
+    assert_matches_ref(case, new_state, metrics)
+
+
+def test_pipeline_seq_attn_dropout_rejected(eight_devices):
+    """Ring attention has no attention-dropout support: a gpt2 config
+    with attn_pdrop > 0 on a pipe x seq mesh fails at build time."""
+    case = build_case(
+        "gpt2", with_ref=False,
+        embd_pdrop=0.1, attn_pdrop=0.1, resid_pdrop=0.1,
+    )
     cfg, model, tx = case["cfg"], case["model"], case["tx"]
     state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
     mcfg = MeshConfig(pipe=2, seq=2, strategy="no_shard")
     mesh = make_mesh(mcfg)
     with pytest.raises(NotImplementedError, match="seq"):
         make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state)
+
+
+def test_pipeline_seq_embd_dropout_trains(eight_devices):
+    """embd/resid dropout composes with in-stage seq (per-shard folded
+    keys, the explicit path's convention): the step runs and the dropout
+    provably engages."""
+    import numpy as np
+
+    case = build_case(
+        "gpt2", with_ref=False, embd_pdrop=0.2, resid_pdrop=0.2,
+    )
+    cfg, model, tx, batch = (
+        case["cfg"], case["model"], case["tx"], case["batch"]
+    )
+    mcfg = MeshConfig(pipe=2, seq=2, strategy="no_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state)
+    _, m = step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+    det = build_case("gpt2", with_ref=False)
+    dstate = init_train_state(
+        det["model"].init(domain_key(42, "init"), det["cfg"]), tx
+    )
+    dstate, _ = shard_pipeline_state(dstate, mesh, mcfg)
+    dstep = make_pipeline_train_step(
+        det["model"], det["cfg"], tx, mesh, mcfg, dstate
+    )
+    _, dm = dstep(dstate, batch, jax.random.key(0))
+    assert abs(float(m["loss"]) - float(dm["loss"])) > 1e-4
