@@ -4,7 +4,11 @@ The reference repo has no inference path at all — training only. A complete
 framework needs one: this module adds prefill + single-token decode over a
 preallocated KV cache, and a jit-compiled ``generate`` loop (greedy or
 temperature sampling), for gpt2 and llama params produced by
-``models.get_model(cfg)``.
+``models.get_model(cfg)`` — dense AND MoE variants (routing is per-token
+and cache-free, see ``_moe_mlp``). ``generate_tp`` runs the same loop
+tensor-parallel over a "tensor" mesh: Megatron-sharded params, local-head
+attention against a local-head cache shard (1/tp of the cache HBM), one
+psum per row-parallel projection.
 
 Design (TPU-first):
 - The cache is a pytree of stacked per-layer tensors ``k/v [L, B, S, Hkv, D]``
@@ -26,6 +30,7 @@ No dropout (inference), no remat (nothing to save).
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Any
 
@@ -46,13 +51,18 @@ Cache = dict[str, jax.Array]
 
 
 def init_cache(
-    cfg: ModelConfig, batch: int, max_len: int, dtype=None
+    cfg: ModelConfig, batch: int, max_len: int, dtype=None,
+    n_kv: int | None = None,
 ) -> Cache:
-    """Preallocate a [L, B, max_len, Hkv, D] key/value cache pair."""
+    """Preallocate a [L, B, max_len, Hkv, D] key/value cache pair.
+    ``n_kv`` overrides the head count for tensor-parallel decode, where
+    each shard caches only its LOCAL kv heads (1/tp of the HBM)."""
     if max_len > cfg.n_ctx:
         raise ValueError(f"max_len {max_len} exceeds n_ctx {cfg.n_ctx}")
     dtype = jnp.dtype(dtype or cfg.dtype)
-    shape = (cfg.n_layer, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    shape = (
+        cfg.n_layer, batch, max_len, n_kv or cfg.kv_heads, cfg.head_dim
+    )
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -82,21 +92,46 @@ def _write(cache_layer, new, pos):
     )
 
 
-def _gpt2_block(x, bp, ck, cv, pos, cfg):
+def _moe_mlp(m, mlp_params, cfg, act):
+    """Routed MLP for decode: top-1/top-k routing is per-token and
+    cache-free, so only the MLP call differs from training. Capacity is
+    set to the no-drop bound (cap = k * tokens): a dropped token at
+    inference would silently zero its MLP contribution, and at decode
+    shapes the slack is negligible."""
+    from pytorch_distributed_tpu.ops.moe import moe_mlp
+
+    out, _ = moe_mlp(
+        m,
+        mlp_params,
+        activation=act,
+        capacity_factor=float(cfg.n_experts),
+        top_k=cfg.moe_top_k,
+        dispatch_impl=cfg.moe_dispatch,
+    )
+    return out
+
+
+def _gpt2_block(x, bp, ck, cv, pos, cfg, tensor_axis=None):
     eps = cfg.layer_norm_epsilon
     b, t = x.shape[:2]
     a = layer_norm(x, bp["ln_1"], eps=eps)
-    qkv = dense(a, bp["attn"]["c_attn"])  # [B, T, 3, H, D]
+    qkv = dense(a, bp["attn"]["c_attn"])  # [B, T, 3, H(/tp), D]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     ck, cv = _write(ck, k, pos), _write(cv, v, pos)
     a = _cached_attention(q, ck, cv, pos).reshape(b, t, -1)
-    x = x + dense(a, bp["attn"]["c_proj"])
+    x = x + dense(a, bp["attn"]["c_proj"], tp_reduce_axis=tensor_axis)
     m = layer_norm(x, bp["ln_2"], eps=eps)
-    m = activation(cfg.activation_function)(dense(m, bp["mlp"]["c_fc"]))
-    return x + dense(m, bp["mlp"]["c_proj"]), ck, cv
+    act = activation(cfg.activation_function)
+    if cfg.n_experts:
+        m = _moe_mlp(m, bp["mlp"], cfg, act)
+        return x + m, ck, cv
+    m = act(dense(m, bp["mlp"]["c_fc"]))
+    return x + dense(m, bp["mlp"]["c_proj"], tp_reduce_axis=tensor_axis), ck, cv
 
 
-def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin):
+def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin, tensor_axis=None):
+    from pytorch_distributed_tpu.ops.tp import tp_reduce
+
     eps = cfg.layer_norm_epsilon
     b, t = x.shape[:2]
     d = cfg.head_dim
@@ -106,11 +141,14 @@ def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin):
     v = (a @ bp["attn"]["wv"].astype(a.dtype)).reshape(b, t, -1, d)
     ck, cv = _write(ck, k, pos), _write(cv, v, pos)
     a = _cached_attention(q, ck, cv, pos).reshape(b, t, -1)
-    x = x + a @ bp["attn"]["wo"].astype(a.dtype)
+    x = x + tp_reduce(a @ bp["attn"]["wo"].astype(a.dtype), tensor_axis)
     m = rms_norm(x, bp["ln_mlp"], eps=eps)
+    if cfg.n_experts:
+        return x + _moe_mlp(m, bp["mlp"], cfg, jax.nn.silu), ck, cv
     gate = jax.nn.silu(m @ bp["mlp"]["gate"].astype(m.dtype))
     up = m @ bp["mlp"]["up"].astype(m.dtype)
-    return x + (gate * up) @ bp["mlp"]["down"].astype(m.dtype), ck, cv
+    down = (gate * up) @ bp["mlp"]["down"].astype(m.dtype)
+    return x + tp_reduce(down, tensor_axis), ck, cv
 
 
 def forward(
@@ -119,11 +157,19 @@ def forward(
     cfg: ModelConfig,
     cache: Cache,
     pos: jax.Array | int,  # tokens already in the cache
+    *,
+    tensor_axis: str | None = None,
 ) -> tuple[jax.Array, Cache]:
     """Run T tokens at positions pos..pos+T-1. Returns ([B, T, V] logits,
-    updated cache)."""
-    if cfg.n_experts:
-        raise NotImplementedError("decode does not support MoE configs yet")
+    updated cache). MoE configs route each token through the expert MLPs
+    (no-drop capacity — see ``_moe_mlp``); routing is stateless, so the
+    KV cache is untouched by the choice of MLP.
+
+    ``tensor_axis``: set when called inside shard_map with block params
+    sharded Megatron-style (tensor-parallel decode): attention runs on
+    the LOCAL heads against a local-head cache shard, row-parallel
+    projections psum over the axis, and the logits come back replicated.
+    """
     b, t = input_ids.shape
     dtype = jnp.dtype(cfg.dtype)
     pos = jnp.asarray(pos, jnp.int32)
@@ -131,13 +177,16 @@ def forward(
     if cfg.family == "gpt2":
         wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, t, axis=0)
         x = (params["wte"][input_ids] + wpe).astype(dtype)
-        block = partial(_gpt2_block, cfg=cfg)
+        block = partial(_gpt2_block, cfg=cfg, tensor_axis=tensor_axis)
     elif cfg.family == "llama":
         x = params["wte"][input_ids].astype(dtype)
         cos, sin = rope_angles(
             t, cfg.head_dim, cfg.rope_theta, offset=pos
         )
-        block = partial(_llama_block, cfg=cfg, cos=cos, sin=sin)
+        block = partial(
+            _llama_block, cfg=cfg, cos=cos, sin=sin,
+            tensor_axis=tensor_axis,
+        )
     else:
         raise KeyError(f"unknown model family {cfg.family!r}")
 
@@ -181,6 +230,57 @@ def _sample(logits, temperature, key, top_k=None, top_p=None):
     )[:, 0].astype(jnp.int32)
 
 
+def _generate_impl(
+    params, prompt, cfg, max_new_tokens, temperature, key,
+    max_len, top_k, top_p, tensor_axis=None, n_kv=None,
+):
+    """Shared generation body: prefill over the prompt, then a fori_loop
+    of single-token decode steps against the cache. Runs plain (generate)
+    or inside shard_map (generate_tp)."""
+    b, tp = prompt.shape
+    total = tp + max_new_tokens
+    max_len = max_len or total
+    if key is None:
+        key = jax.random.key(0)  # unused on the greedy path
+
+    cache = init_cache(cfg, b, max_len, n_kv=n_kv)
+    if tensor_axis is not None:
+        # The cache carries tensor-sharded values (local-head K/V); its
+        # zero init must be typed varying over the axis or the fori_loop
+        # carry types mismatch under check_vma.
+        from pytorch_distributed_tpu.ops.tp import pvary_missing
+
+        cache = jax.tree.map(
+            lambda c: pvary_missing(c, (tensor_axis,)), cache
+        )
+    logits, cache = forward(
+        params, prompt, cfg, cache, 0, tensor_axis=tensor_axis
+    )
+    next_tok = _sample(logits[:, -1], temperature, key, top_k, top_p)
+
+    out = jnp.zeros((b, total), jnp.int32)
+    out = jax.lax.dynamic_update_slice(out, prompt.astype(jnp.int32), (0, 0))
+    out = out.at[:, tp].set(next_tok)
+
+    def step(i, carry):
+        out, cache, tok = carry
+        pos = tp + i
+        logits, cache = forward(
+            params, tok[:, None], cfg, cache, pos, tensor_axis=tensor_axis
+        )
+        nxt = _sample(
+            logits[:, -1], temperature, jax.random.fold_in(key, i), top_k,
+            top_p,
+        )
+        out = out.at[:, pos + 1].set(nxt)
+        return out, cache, nxt
+
+    out, _, _ = jax.lax.fori_loop(
+        0, max_new_tokens - 1, step, (out, cache, next_tok)
+    )
+    return out
+
+
 @partial(
     jax.jit,
     static_argnames=(
@@ -204,40 +304,124 @@ def generate(
     One compiled program: prefill over the prompt, then a fori_loop of
     single-token decode steps against the cache.
     """
-    b, tp = prompt.shape
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     if max_new_tokens == 0:
         # Nothing to generate: the prompt IS the output (the write of the
         # first sampled token below would statically index out of bounds).
         return prompt.astype(jnp.int32)
-    total = tp + max_new_tokens
-    max_len = max_len or total
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature sampling requires a PRNG key")
+    return _generate_impl(
+        params, prompt, cfg, max_new_tokens, temperature, key,
+        max_len, top_k, top_p,
+    )
+
+
+def generate_tp(
+    params: Params,
+    prompt: jax.Array,  # [B, Tp] int
+    cfg: ModelConfig,
+    mesh_cfg,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    max_len: int | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """Tensor-parallel generation over a "tensor" mesh (meshed decode —
+    models whose weights exceed one chip sample across tp shards).
+
+    Block params shard Megatron-style per parallel/sharding.py's rule
+    table (the SAME layout training leaves them in, so a trained sharded
+    state decodes with no resharding); each shard runs attention on its
+    LOCAL heads against a local-head KV cache (1/tp of the cache HBM),
+    row-parallel projections psum over the axis, and the replicated
+    logits sample identically on every shard.
+    """
+    tp_size = mesh_cfg.tensor
+    if tp_size <= 1:
+        raise ValueError("generate_tp needs mesh_cfg.tensor > 1")
+    for ax in ("data", "fsdp", "seq", "pipe", "expert"):
+        if getattr(mesh_cfg, ax) > 1:
+            raise NotImplementedError(
+                f"generate_tp supports a tensor-only mesh (got {ax}="
+                f"{getattr(mesh_cfg, ax)})"
+            )
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "tensor-parallel decode does not support MoE configs "
+            "(single-device MoE decode works: models/decode.generate)"
+        )
+    if cfg.n_head % tp_size or cfg.kv_heads % tp_size:
+        raise ValueError(
+            f"tensor={tp_size} must divide n_head={cfg.n_head} and "
+            f"kv_heads={cfg.kv_heads}"
+        )
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt.astype(jnp.int32)
     if temperature > 0.0 and key is None:
         raise ValueError("temperature sampling requires a PRNG key")
     if key is None:
-        key = jax.random.key(0)  # unused on the greedy path
+        key = jax.random.key(0)
 
-    cache = init_cache(cfg, b, max_len)
-    logits, cache = forward(params, prompt, cfg, cache, 0)
-    next_tok = _sample(logits[:, -1], temperature, key, top_k, top_p)
-
-    out = jnp.zeros((b, total), jnp.int32)
-    out = jax.lax.dynamic_update_slice(out, prompt.astype(jnp.int32), (0, 0))
-    out = out.at[:, tp].set(next_tok)
-
-    def step(i, carry):
-        out, cache, tok = carry
-        pos = tp + i
-        logits, cache = forward(params, tok[:, None], cfg, cache, pos)
-        nxt = _sample(
-            logits[:, -1], temperature, jax.random.fold_in(key, i), top_k,
-            top_p,
-        )
-        out = out.at[:, pos + 1].set(nxt)
-        return out, cache, nxt
-
-    out, _, _ = jax.lax.fori_loop(
-        0, max_new_tokens - 1, step, (out, cache, next_tok)
+    fn, shardings = _tp_generate_compiled(
+        cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
     )
-    return out
+    # device_put with the target shardings is a no-op when params are
+    # already placed, so repeat calls only pay the (cached) jit lookup.
+    return fn(jax.device_put(params, shardings), prompt, key)
+
+
+@functools.lru_cache(maxsize=None)
+def _tp_generate_compiled(
+    cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
+):
+    """(jitted shard_map generate fn, param shardings) for one static
+    config — cached so a serving loop does not retrace/recompile the
+    whole prefill+fori_loop program per generate_tp call (both config
+    dataclasses are frozen, hence hashable). Param specs are derived
+    from the abstract init so the cache needs no concrete params."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel.mesh import make_mesh
+    from pytorch_distributed_tpu.parallel.sharding import (
+        param_partition_specs,
+    )
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    mesh = make_mesh(mesh_cfg)
+    abstract = jax.eval_shape(
+        lambda k: get_model(cfg).init(k, cfg), jax.random.key(0)
+    )
+    p_specs = param_partition_specs(abstract, mesh_cfg)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        p_specs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+    )
+
+    def body(params, prompt, key):
+        return _generate_impl(
+            params, prompt, cfg, max_new_tokens, temperature, key,
+            max_len, top_k, top_p,
+            tensor_axis="tensor", n_kv=cfg.kv_heads // mesh_cfg.tensor,
+        )
+
+    smapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(p_specs, P(), P()),
+        out_specs=P(),
+        check_vma=True,
+    )
+    return jax.jit(smapped), shardings

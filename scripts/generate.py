@@ -52,6 +52,12 @@ def main() -> int:
                     help="nucleus sampling: smallest token set whose "
                          "probability mass reaches p (applies within "
                          "--top-k when both are set)")
+    ap.add_argument("--n-experts", type=int, default=0,
+                    help="MoE expert count — must match the trained "
+                         "checkpoint's (decode routes per token, no cache "
+                         "impact)")
+    ap.add_argument("--moe-top-k", type=int, default=1,
+                    help="router top-k of the trained MoE checkpoint")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -66,6 +72,10 @@ def main() -> int:
     )
     if args.n_ctx:
         cfg = cfg.replace(n_ctx=args.n_ctx)
+    if args.n_experts:
+        cfg = cfg.replace(
+            n_experts=args.n_experts, moe_top_k=args.moe_top_k
+        )
 
     tok = None
     if args.hf or args.tokenizer:
