@@ -183,12 +183,22 @@ def _local_shards(args) -> list[str]:
     return paths
 
 
+def _holds_out_val_shard(args, paths) -> bool:
+    """Whether shard_paths excludes the last local shard for validation.
+    The SINGLE predicate both shard_paths and val_shard_paths consult, so
+    the train list and the overlap warning cannot drift. Note it depends
+    on eval_batches: resuming a checkpointed run with eval toggled
+    CHANGES the training shard list (and therefore the data stream) —
+    val_shard_paths warns when the shard it returns was not held out."""
+    return len(paths) > 1 and getattr(args, "eval_batches", 0) > 0
+
+
 def shard_paths(args, vocab_size: int) -> list[str]:
     if args.data == "local":
         paths = _local_shards(args)
         # Hold the last shard out for validation ONLY when this run
         # actually evaluates — a train-only run keeps its whole corpus.
-        if len(paths) > 1 and getattr(args, "eval_batches", 0) > 0:
+        if _holds_out_val_shard(args, paths):
             print(
                 f"--data local: holding out {paths[-1]!r} as the "
                 f"validation shard (training on {len(paths) - 1} shard(s))"
@@ -227,6 +237,15 @@ def val_shard_paths(args, vocab_size: int) -> list[str]:
             print(
                 "WARNING: --data local has a single shard; validation "
                 "overlaps training data, so val loss is optimistic"
+            )
+        elif not _holds_out_val_shard(args, paths):
+            # Multi-shard but the holdout didn't engage (eval was off or
+            # the caller never sets eval_batches): the shard returned here
+            # was part of training.
+            print(
+                f"WARNING: --data local: validation shard {paths[-1]!r} "
+                "was NOT held out of training (holdout engages only when "
+                "eval_batches > 0), so val loss is optimistic"
             )
         return [paths[-1]]
     if args.data == "fineweb":
