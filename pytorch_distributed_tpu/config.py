@@ -78,9 +78,10 @@ class ModelConfig:
     # Selective activation checkpointing per block (reference my_gpt2.py:145,
     # 175-183 + pytorch_utils.py:5-17): save compute-intensive matmul outputs,
     # recompute the rest. One of: "none", "full", "dots", "dots_no_batch",
-    # or "names" (recommended: saves the tagged projection outputs and the
-    # flash kernel's o/l/m, but never the quadratic score matrix — see
-    # ops/remat.py).
+    # "names" (recommended: saves the tagged projection outputs and the
+    # flash kernel's o/l/m, but never the quadratic score matrix), or
+    # "flash" (ONLY the flash o/l/m — the long-context policy for
+    # regimes where per-layer projection saves OOM HBM; see ops/remat.py).
     remat: str = "dots"
     # Unroll factor for the scan-over-layers (1 = no unroll). Unrolling
     # lets XLA fuse/pipeline across layer boundaries (e.g. merge adjacent

@@ -68,17 +68,20 @@ def _pick_block(t: int, preferred: int) -> int:
     return t
 
 
-def _compiler_params():
+def _compiler_params(vmem_limit_bytes: int | None = None):
     # b and h grid dims are independent; the innermost dim carries
     # sequential state (fwd: resident K/V reuse; bwd: dq accumulation).
-    try:
-        return {
-            "compiler_params": pltpu.CompilerParams(
-                dimension_semantics=("parallel", "parallel", "arbitrary")
-            )
-        }
-    except (TypeError, AttributeError):  # signature drift across jax versions
-        return {}
+    kw = {"dimension_semantics": ("parallel", "parallel", "arbitrary")}
+    if vmem_limit_bytes is not None:
+        kw["vmem_limit_bytes"] = vmem_limit_bytes
+    # Staged fallback across jax-version signature drift: losing the new
+    # vmem kwarg must not silently drop dimension_semantics with it.
+    while kw:
+        try:
+            return {"compiler_params": pltpu.CompilerParams(**kw)}
+        except (TypeError, AttributeError):
+            kw.pop(sorted(kw)[-1])  # vmem_limit_bytes first, then the rest
+    return {}
 
 
 # --------------------------------------------------------------------------
@@ -372,7 +375,19 @@ def _bwd_call(q, k, v, do, lse, delta, causal, scale, bq, bk, interpret):
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-        **_compiler_params(),
+        # The kernel keeps q/do (bf16) and the accumulating dq (f32)
+        # resident per (b, h) — a footprint that scales with T, and
+        # Mosaic's scheduling overheads scale with it too: the observed
+        # scoped-vmem demand at llama3-1B T=8192 D=64 is ~17.5-33 MB
+        # against the 16 MB default budget. Past T*D = 4096*64 raise the
+        # per-kernel limit so long-context training compiles out of the
+        # box; at or below it (every bench shape), leave the default
+        # untouched so the measured schedules don't shift.
+        **_compiler_params(
+            vmem_limit_bytes=(
+                96 * 1024 * 1024 if t * d > 4096 * 64 else None
+            )
+        ),
     )(q, k, v, do, lse8, delta8)
     return dq, dk, dv
 

@@ -81,14 +81,22 @@ _POLICIES = {
         ),
         _flash_call_policy,
     ),
+    # Save ONLY the flash kernel's (o, l, m): removes the O(T^2)
+    # forward-kernel re-run from backward while keeping every linear-in-T
+    # projection save OFF — the long-context policy for regimes where the
+    # per-layer gate/up saves are what OOM HBM (llama3-1B T=8192 fits
+    # with this or "full"; "names"/"dots" exceed the chip — measured
+    # round 5, benchmarks/PERF_NOTES.md).
+    "flash": _flash_call_policy,
 }
 
 
 def apply_remat(fn, mode: str, *, prevent_cse: bool = False, static_argnums=()):
     """Wrap ``fn`` in jax.checkpoint according to ``mode``.
 
-    mode: "none" (identity), "full", "dots", "dots_no_batch", "names".
-    prevent_cse=False is safe (and faster) under scan-over-layers.
+    mode: "none" (identity), "full", "dots", "dots_no_batch", "names",
+    "flash". prevent_cse=False is safe (and faster) under
+    scan-over-layers.
     """
     if mode == "none":
         return fn

@@ -54,9 +54,16 @@ def activation_bytes_estimate(
     elif cfg.remat == "full":
         per_layer_tokens = b * t * e  # only block inputs saved
         score_bytes = 0
-    else:  # dots / dots_no_batch
+    elif cfg.remat == "flash":
+        # Only the flash kernel's (o, l, m) per layer — the long-context
+        # policy; the o save is E per token, l/m are f32 [B, H, T].
+        per_layer_tokens = b * t * (e + e)  # block input + o
+        score_bytes = l * b * h * t * 4 * 2  # l and m, f32
+    else:  # dots / dots_no_batch / names
         score_bytes = 0
-    logits_bytes = b * t * cfg.vocab_size * 4
+    logits_bytes = (
+        0 if cfg.fused_head_ce else b * t * cfg.vocab_size * 4
+    )
     return l * per_layer_tokens * act_itemsize + score_bytes + logits_bytes
 
 

@@ -94,7 +94,8 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
                         "dots the saved f32 scores OOM any >12-layer model "
                         "at T=1024 on a 16 GB chip)")
     p.add_argument("--remat", default="names",
-                   choices=["none", "full", "dots", "dots_no_batch", "names"],
+                   choices=["none", "full", "dots", "dots_no_batch",
+                            "names", "flash"],
                    help="activation-checkpoint policy (names = save tagged "
                         "projection outputs, the measured optimum — default)")
     p.add_argument("--no-profiler", action="store_true")

@@ -103,6 +103,27 @@ ROWS = {
         fused_head_ce=True,
         ring_projection=dict(n_chips=2),  # T_global=8192 over seq=2
     ),
+    # Round 5: T=8192 MEASURED on one chip (the regime round 4 projected
+    # as infeasible). Three things unlock it: the fused flash backward
+    # kernel's per-kernel vmem budget now scales past Mosaic's 16 MB
+    # default (ops/flash_kernel.py), the fused head+CE keeps the logits
+    # out of HBM, and the "flash" remat policy saves ONLY the kernel's
+    # (o, l, m) — the remat ladder at this length: names/dots OOM HBM
+    # (17.5G/17.5G vs 15.75G), full fits at 46.9% MFU, flash fits and
+    # wins at 53.4%. B=2 OOMs by 140 MB — B=1 is the single-chip
+    # ceiling. The ring projection extends to T_global=16384 over seq=2.
+    7: dict(
+        name="llama3-1B long-context T=8192",
+        preset="llama3-1b",
+        parallelism="none",
+        measured=True,
+        batch=1,
+        seq_len=8192,
+        param_dtype="bfloat16",
+        remat="flash",
+        fused_head_ce=True,
+        ring_projection=dict(n_chips=2),  # T_global=16384 over seq=2
+    ),
 }
 
 V5E_PEAK_BF16 = 197e12

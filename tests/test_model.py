@@ -79,7 +79,7 @@ def test_remat_modes_agree(tiny_config):
     def loss(p, cfg):
         return cross_entropy_loss(gpt2.apply(p, ids, cfg), ids)
 
-    for mode in ("dots", "full", "dots_no_batch"):
+    for mode in ("dots", "full", "dots_no_batch", "names", "flash"):
         cfg_m = tiny_config.replace(remat=mode)
         np.testing.assert_allclose(
             float(loss(params, cfg_none)), float(loss(params, cfg_m)), rtol=1e-6
