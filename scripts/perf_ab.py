@@ -31,6 +31,11 @@ VARIANTS = {
     "dots": dict(remat="dots"),
     "no_remat": dict(remat="none"),
     "full_remat": dict(remat="full"),
+    # Long-context policy (round 5): only the flash kernel's o/l/m.
+    # Reproduce the T=8192 ladder with e.g.:
+    #   perf_ab.py --preset llama3-1b --param-dtype bfloat16 --batch-size 1
+    #     --seq-len 8192 --fused-head-ce --variants flash_remat,full_remat
+    "flash_remat": dict(remat="flash"),
 }
 
 

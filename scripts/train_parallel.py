@@ -130,11 +130,11 @@ def main() -> int:
     if args.n_experts:
         model_cfg = model_cfg.replace(n_experts=args.n_experts)
     if args.seq_impl != "ring":
-        if args.path != "explicit" or axes.get("seq", 1) <= 1:
+        if args.path not in ("explicit", "pipeline") or axes.get("seq", 1) <= 1:
             raise SystemExit(
-                "--seq-impl ulysses requires --path explicit and a seq>1 "
-                "mesh axis (the auto path shards T via NamedSharding and "
-                "never calls the CP kernels)"
+                "--seq-impl ulysses requires --path explicit or pipeline "
+                "and a seq>1 mesh axis (the auto path shards T via "
+                "NamedSharding and never calls the CP kernels)"
             )
         model_cfg = model_cfg.replace(seq_impl=args.seq_impl)
     if args.no_dropout or mesh_cfg.seq > 1:
