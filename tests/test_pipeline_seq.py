@@ -71,6 +71,21 @@ def test_pipeline_seq_matches_single_device(
     assert_matches_ref(setup, new_state, metrics)
 
 
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_seq_tensor_matches_single_device(setup, schedule):
+    """PP x SP x TP — in-stage sequence AND Megatron tensor parallelism
+    together: the ring runs over "seq" on the stage's LOCAL heads (the
+    head shard and the token shard are independent), tp psums ride
+    "tensor", the pipeline's ppermute rides "pipe", and the composed step
+    reproduces the single-device accumulated step on both schedules."""
+    mcfg = MeshConfig(
+        pipe=2, seq=2, tensor=2, strategy="no_shard",
+        pipe_schedule=schedule,
+    )
+    new_state, metrics = _run_pipeline(setup, mcfg, schedule)
+    assert_matches_ref(setup, new_state, metrics)
+
+
 def test_pipeline_seq_ulysses_matches_single_device(setup):
     """The Ulysses (head/sequence all-to-all) context-parallel technique
     also composes in-stage: cfg.seq_impl picks it, and all_to_all lowers
