@@ -1,0 +1,106 @@
+"""KV-cache decode throughput on the real chip.
+
+The reference repo has no inference path, so there is no baseline to
+compare against — this publishes the framework's own generation numbers
+(benchmarks/PERF_NOTES.md "Decode throughput"). Methodology follows
+bench.py's relay hygiene: fresh random prompts per run (the relay caches
+deterministic repeat computations), timing is dispatch -> device_get of
+the output tokens, and the incremental rate between two generation
+lengths cancels the prefill and fixed dispatch overheads:
+
+  rate = B * (N2 - N1) / (t(N2) - t(N1))
+
+Usage:
+  python scripts/decode_bench.py                    # gpt2 + llama3-1b
+  python scripts/decode_bench.py --preset gpt2 --batch 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import setup_platform  # noqa: E402  (bootstraps the repo root)
+
+
+def bench_decode(preset: str, batch: int, prompt_len: int,
+                 n1: int, n2: int, repeats: int) -> dict:
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.config import model_config
+    from pytorch_distributed_tpu.models import decode, get_model
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    seed = int.from_bytes(os.urandom(4), "little")
+    kw = dict(dtype="bfloat16", param_dtype="bfloat16")
+    cfg = model_config(preset, **kw).replace(
+        embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+        n_ctx=min(model_config(preset).n_ctx, prompt_len + n2),
+    )
+    model = get_model(cfg)
+    params = model.init(domain_key(seed, "init"), cfg)
+    rng = np.random.default_rng(seed)
+
+    def run(max_new):
+        prompt = jax.numpy.asarray(
+            rng.integers(0, cfg.vocab_size, (batch, prompt_len)),
+            jax.numpy.int32,
+        )
+        t0 = time.perf_counter()
+        out = decode.generate(
+            params, prompt, cfg, max_new,
+            max_len=prompt_len + n2,  # one cache shape -> one compile
+        )
+        np.asarray(out)  # device_get fences the relay
+        return time.perf_counter() - t0
+
+    run(n1)  # compile both programs (generate jit-caches per max_new)
+    run(n2)
+    rates = []
+    for _ in range(repeats):
+        t1, t2 = run(n1), run(n2)
+        rates.append(batch * (n2 - n1) / (t2 - t1))
+    med = sorted(rates)[len(rates) // 2]
+    return dict(
+        preset=preset,
+        batch=batch,
+        prompt_len=prompt_len,
+        incremental_tokens_per_sec=round(med, 1),
+        per_sequence_tokens_per_sec=round(med / batch, 1),
+        spread=round(max(rates) / max(min(rates), 1e-9), 3),
+        platform=jax.devices()[0].platform,
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", default=None,
+                    help="single preset (default: gpt2 AND llama3-1b)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--prompt-len", type=int, default=32)
+    ap.add_argument("--n1", type=int, default=32)
+    ap.add_argument("--n2", type=int, default=160)
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force CPU platform with this many virtual devices "
+                         "(cluster-free smoke; throughput not meaningful)")
+    args = ap.parse_args()
+    setup_platform(args)
+
+    presets = [args.preset] if args.preset else ["gpt2", "llama3-1b"]
+    for preset in presets:
+        res = bench_decode(
+            preset, args.batch, args.prompt_len, args.n1, args.n2,
+            args.repeats,
+        )
+        print(json.dumps(res))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
