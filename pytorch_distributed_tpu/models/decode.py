@@ -92,12 +92,15 @@ def _write(cache_layer, new, pos):
     )
 
 
-def _moe_mlp(m, mlp_params, cfg, act):
+def _moe_mlp(m, mlp_params, cfg, act, tensor_axis=None):
     """Routed MLP for decode: top-1/top-k routing is per-token and
     cache-free, so only the MLP call differs from training. Capacity is
     set to the no-drop bound (cap = k * tokens): a dropped token at
     inference would silently zero its MLP contribution, and at decode
-    shapes the slack is negligible."""
+    shapes the slack is negligible. ``tensor_axis``: Megatron TP inside
+    each expert (the training EP x TP placement, ops/moe._expert_compute)
+    — routing runs on replicated activations so it agrees across shards,
+    and the in-expert tp_reduce restores the full output."""
     from pytorch_distributed_tpu.ops.moe import moe_mlp
 
     out, _ = moe_mlp(
@@ -107,6 +110,7 @@ def _moe_mlp(m, mlp_params, cfg, act):
         capacity_factor=float(cfg.n_experts),
         top_k=cfg.moe_top_k,
         dispatch_impl=cfg.moe_dispatch,
+        tensor_axis=tensor_axis,
     )
     return out
 
@@ -123,7 +127,7 @@ def _gpt2_block(x, bp, ck, cv, pos, cfg, tensor_axis=None):
     m = layer_norm(x, bp["ln_2"], eps=eps)
     act = activation(cfg.activation_function)
     if cfg.n_experts:
-        m = _moe_mlp(m, bp["mlp"], cfg, act)
+        m = _moe_mlp(m, bp["mlp"], cfg, act, tensor_axis)
         return x + m, ck, cv
     m = act(dense(m, bp["mlp"]["c_fc"]))
     return x + dense(m, bp["mlp"]["c_proj"], tp_reduce_axis=tensor_axis), ck, cv
@@ -144,7 +148,7 @@ def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin, tensor_axis=None):
     x = x + tp_reduce(a @ bp["attn"]["wo"].astype(a.dtype), tensor_axis)
     m = rms_norm(x, bp["ln_mlp"], eps=eps)
     if cfg.n_experts:
-        return x + _moe_mlp(m, bp["mlp"], cfg, jax.nn.silu), ck, cv
+        return x + _moe_mlp(m, bp["mlp"], cfg, jax.nn.silu, tensor_axis), ck, cv
     gate = jax.nn.silu(m @ bp["mlp"]["gate"].astype(m.dtype))
     up = m @ bp["mlp"]["up"].astype(m.dtype)
     down = (gate * up) @ bp["mlp"]["down"].astype(m.dtype)
@@ -350,10 +354,10 @@ def generate_tp(
                 f"generate_tp supports a tensor-only mesh (got {ax}="
                 f"{getattr(mesh_cfg, ax)})"
             )
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "tensor-parallel decode does not support MoE configs "
-            "(single-device MoE decode works: models/decode.generate)"
+    if cfg.n_experts and cfg.inner_dim % tp_size:
+        raise ValueError(
+            f"tensor={tp_size} must divide the MoE expert hidden dim "
+            f"inner_dim={cfg.inner_dim} (experts run Megatron TP on F)"
         )
     if cfg.n_head % tp_size or cfg.kv_heads % tp_size:
         raise ValueError(

@@ -233,7 +233,24 @@ def test_generate_tp_matches_single_device(eight_devices, family):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_generate_tp_rejects_bad_meshes_and_moe(eight_devices):
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_generate_tp_moe_matches_single_device(eight_devices, family):
+    """MoE x TP decode: expert FFNs run Megatron TP on their hidden dim
+    (the training EP x TP placement), the router stays replicated so
+    routing agrees across shards — token-for-token identical to the
+    single-device greedy MoE decode."""
+    from pytorch_distributed_tpu.config import MeshConfig
+
+    cfg = _cfg(family, n_experts=4, expert_capacity_factor=8.0)
+    model = get_model(cfg)
+    params = model.init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(7), (2, 5), 0, cfg.vocab_size)
+    ref = decode.generate(params, prompt, cfg, 8)
+    out = decode.generate_tp(params, prompt, cfg, MeshConfig(tensor=2), 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_generate_tp_rejects_bad_meshes(eight_devices):
     from pytorch_distributed_tpu.config import MeshConfig
 
     cfg = _cfg("gpt2")
@@ -245,9 +262,9 @@ def test_generate_tp_rejects_bad_meshes_and_moe(eight_devices):
         decode.generate_tp(
             params, prompt, cfg, MeshConfig(tensor=2, data=2), 2
         )
-    moe_cfg = _cfg("gpt2", n_experts=4)
+    moe_cfg = _cfg("gpt2", n_experts=4, n_inner=63)
     moe_params = get_model(moe_cfg).init(jax.random.key(0), moe_cfg)
-    with pytest.raises(NotImplementedError, match="MoE"):
+    with pytest.raises(ValueError, match="inner_dim"):
         decode.generate_tp(
             moe_params, prompt, moe_cfg, MeshConfig(tensor=2), 2
         )
