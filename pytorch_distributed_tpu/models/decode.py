@@ -244,8 +244,8 @@ def _generate_impl(
     b, tp = prompt.shape
     total = tp + max_new_tokens
     max_len = max_len or total
-    if key is None:
-        key = jax.random.key(0)  # unused on the greedy path
+    # key is never None here: _check_sample_args owns the greedy-path
+    # dummy-key substitution for every entry point.
 
     cache = init_cache(cfg, b, max_len, n_kv=n_kv)
     if tensor_axis is not None:
@@ -308,14 +308,9 @@ def generate(
     One compiled program: prefill over the prompt, then a fori_loop of
     single-token decode steps against the cache.
     """
-    if max_new_tokens < 0:
-        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
-    if max_new_tokens == 0:
-        # Nothing to generate: the prompt IS the output (the write of the
-        # first sampled token below would statically index out of bounds).
-        return prompt.astype(jnp.int32)
-    if temperature > 0.0 and key is None:
-        raise ValueError("temperature sampling requires a PRNG key")
+    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
+    if early is not None:
+        return early
     return _generate_impl(
         params, prompt, cfg, max_new_tokens, temperature, key,
         max_len, top_k, top_p,
@@ -364,14 +359,9 @@ def generate_tp(
             f"tensor={tp_size} must divide n_head={cfg.n_head} and "
             f"kv_heads={cfg.kv_heads}"
         )
-    if max_new_tokens < 0:
-        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
-    if max_new_tokens == 0:
-        return prompt.astype(jnp.int32)
-    if temperature > 0.0 and key is None:
-        raise ValueError("temperature sampling requires a PRNG key")
-    if key is None:
-        key = jax.random.key(0)
+    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
+    if early is not None:
+        return early
 
     fn, shardings = _tp_generate_compiled(
         cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
@@ -381,27 +371,35 @@ def generate_tp(
     return fn(jax.device_put(params, shardings), prompt, key)
 
 
-@functools.lru_cache(maxsize=None)
-def _tp_generate_compiled(
-    cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
-):
-    """(jitted shard_map generate fn, param shardings) for one static
-    config — cached so a serving loop does not retrace/recompile the
-    whole prefill+fori_loop program per generate_tp call (both config
-    dataclasses are frozen, hence hashable). Param specs are derived
-    from the abstract init so the cache needs no concrete params."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def _check_sample_args(prompt, max_new_tokens, temperature, key):
+    """Shared generate-entry validation. Returns (early_out, key): when
+    ``early_out`` is not None the caller returns it unchanged (nothing to
+    generate — the write of the first sampled token would statically index
+    out of bounds); otherwise ``key`` is non-None (greedy paths get a
+    dummy, unused by sampling)."""
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt.astype(jnp.int32), key
+    if temperature > 0.0 and key is None:
+        raise ValueError("temperature sampling requires a PRNG key")
+    if key is None:
+        key = jax.random.key(0)
+    return None, key
+
+
+def _mesh_param_shardings(cfg, mesh_cfg):
+    """(mesh, partition-spec tree, NamedSharding tree) for decode params
+    under ``mesh_cfg`` — shared by the meshed decode paths so spec
+    derivation cannot diverge between them. Specs come from the abstract
+    init, so no concrete params are needed (lru_cache-friendly)."""
+    from jax.sharding import NamedSharding
 
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.parallel.mesh import make_mesh
     from pytorch_distributed_tpu.parallel.sharding import (
         param_partition_specs,
     )
-
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
 
     mesh = make_mesh(mesh_cfg)
     abstract = jax.eval_shape(
@@ -413,6 +411,103 @@ def _tp_generate_compiled(
         p_specs,
         is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
     )
+    return mesh, p_specs, shardings
+
+
+def generate_fsdp(
+    params: Params,
+    prompt: jax.Array,  # [B, Tp] int
+    cfg: ModelConfig,
+    mesh_cfg,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    max_len: int | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """Decode from ZeRO-3-sharded params over an "fsdp" mesh — sample IN
+    PLACE from the layout full-shard training leaves the weights in (no
+    resharding, and per-chip param HBM stays 1/fsdp of the model).
+
+    Unlike ``generate_tp`` (shard_map + hand-placed psums), this is the
+    auto path: the decode loop is jitted with the params carrying their
+    full_shard NamedShardings and XLA's SPMD partitioner inserts the
+    gathers. The stacked [L, ...] block leaves shard a WEIGHT dim (never
+    L — parallel/sharding.py), so inside the scan-over-layers each
+    iteration all_gathers only its own layer slice: one layer's gathered
+    weights are live at a time, the same per-block-gather discipline
+    full-shard training uses. MoE configs work unchanged (routing and
+    dispatch are ordinary auto-sharded ops here).
+    """
+    if mesh_cfg.fsdp <= 1:
+        raise ValueError("generate_fsdp needs mesh_cfg.fsdp > 1")
+    for ax in ("data", "tensor", "seq", "pipe", "expert"):
+        if getattr(mesh_cfg, ax) > 1:
+            raise NotImplementedError(
+                f"generate_fsdp supports an fsdp-only mesh (got {ax}="
+                f"{getattr(mesh_cfg, ax)}); combine with generate_tp's "
+                "tensor sharding is future surface"
+            )
+    if mesh_cfg.strategy != "full_shard":
+        raise ValueError(
+            "generate_fsdp decodes from full_shard (ZeRO-3) param "
+            f"layouts; strategy={mesh_cfg.strategy!r} keeps params "
+            "replicated — plain generate already covers it"
+        )
+    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
+    if early is not None:
+        return early
+
+    fn, shardings = _fsdp_generate_compiled(
+        cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
+    )
+    return fn(jax.device_put(params, shardings), prompt, key)
+
+
+@functools.lru_cache(maxsize=None)
+def _fsdp_generate_compiled(
+    cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
+):
+    """(jitted auto-path generate fn, full_shard param shardings) for one
+    static config — cached like _tp_generate_compiled."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh, _, shardings = _mesh_param_shardings(cfg, mesh_cfg)
+    replicated = NamedSharding(mesh, P())
+
+    def body(params, prompt, key):
+        return _generate_impl(
+            params, prompt, cfg, max_new_tokens, temperature, key,
+            max_len, top_k, top_p,
+        )
+
+    fn = jax.jit(
+        body,
+        in_shardings=(shardings, replicated, replicated),
+        out_shardings=replicated,
+    )
+    return fn, shardings
+
+
+@functools.lru_cache(maxsize=None)
+def _tp_generate_compiled(
+    cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
+):
+    """(jitted shard_map generate fn, param shardings) for one static
+    config — cached so a serving loop does not retrace/recompile the
+    whole prefill+fori_loop program per generate_tp call (both config
+    dataclasses are frozen, hence hashable). Param specs are derived
+    from the abstract init so the cache needs no concrete params."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    mesh, p_specs, shardings = _mesh_param_shardings(cfg, mesh_cfg)
 
     def body(params, prompt, key):
         return _generate_impl(

@@ -18,6 +18,8 @@ Examples:
   python scripts/generate.py --prompt-ids 1,2,3 --max-new-tokens 16
   python scripts/generate.py --hf gpt2 --prompt "The TPU is" --top-k 40 \\
       --temperature 0.8
+  python scripts/generate.py --mesh tensor=2 --cpu-devices 8 ...   # TP decode
+  python scripts/generate.py --mesh fsdp=4 ...     # ZeRO-3-sharded weights
 """
 
 from __future__ import annotations
@@ -59,10 +61,52 @@ def main() -> int:
     ap.add_argument("--moe-top-k", type=int, default=1,
                     help="router top-k of the trained MoE checkpoint")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mesh", default="",
+                    help="decode under a mesh: 'tensor=N' (Megatron-"
+                         "sharded params + local-head KV cache shards, "
+                         "models/decode.generate_tp) or 'fsdp=N' (decode "
+                         "in place from the ZeRO-3 training layout, "
+                         "generate_fsdp); empty = single device")
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force a virtual N-device CPU platform (cluster-"
+                         "free mesh runs, same as train_parallel.py)")
     args = ap.parse_args()
+
+    from _common import setup_platform
+
+    setup_platform(args)
+
+    # Validate --mesh BEFORE any weight IO (an HF pull or checkpoint
+    # restore can be multi-GB; a typo'd axis should not cost that).
+    mesh_cfg = None
+    if args.mesh:
+        from train_parallel import parse_mesh
+        from pytorch_distributed_tpu.config import MeshConfig
+
+        mesh_cfg = MeshConfig(**parse_mesh(args.mesh))
+        # Decode meshes are single-technique: exactly one of tensor/fsdp
+        # > 1, every other axis 1 (the same contract generate_tp /
+        # generate_fsdp enforce — checked HERE so a bad spec cannot cost
+        # a multi-GB weight load first).
+        sizes = {
+            ax: getattr(mesh_cfg, ax)
+            for ax in ("data", "fsdp", "tensor", "seq", "pipe", "expert")
+        }
+        active = [ax for ax, n in sizes.items() if n > 1]
+        if active not in (["fsdp"], ["tensor"]):
+            raise SystemExit(
+                "--mesh for decoding must set exactly one of tensor=N or "
+                f"fsdp=N (got {args.mesh!r})"
+            )
 
     import jax
     import numpy as np
+
+    if mesh_cfg is not None and mesh_cfg.num_devices > len(jax.devices()):
+        raise SystemExit(
+            f"--mesh {args.mesh} needs {mesh_cfg.num_devices} devices but "
+            f"only {len(jax.devices())} are available (try --cpu-devices N)"
+        )
 
     from pytorch_distributed_tpu.config import model_config
     from pytorch_distributed_tpu.models import decode, get_model
@@ -76,6 +120,22 @@ def main() -> int:
         cfg = cfg.replace(
             n_experts=args.n_experts, moe_top_k=args.moe_top_k
         )
+
+    # Tensor-divisibility is checkable pre-load whenever cfg is known
+    # up front (--preset / --checkpoint; --hf derives cfg FROM the
+    # download, so its late check in generate_tp still applies).
+    if mesh_cfg is not None and mesh_cfg.tensor > 1 and not args.hf:
+        tp = mesh_cfg.tensor
+        if cfg.n_head % tp or cfg.kv_heads % tp:
+            raise SystemExit(
+                f"--mesh tensor={tp} must divide n_head={cfg.n_head} and "
+                f"kv_heads={cfg.kv_heads} of preset {args.preset!r}"
+            )
+        if cfg.n_experts and cfg.inner_dim % tp:
+            raise SystemExit(
+                f"--mesh tensor={tp} must divide the MoE hidden dim "
+                f"inner_dim={cfg.inner_dim} of preset {args.preset!r}"
+            )
 
     tok = None
     if args.hf or args.tokenizer:
@@ -118,16 +178,26 @@ def main() -> int:
             [[int(t) for t in args.prompt_ids.split(",")]], np.int32
         )
 
-    out = decode.generate(
-        params,
-        jax.numpy.asarray(ids),
-        cfg,
-        args.max_new_tokens,
+    sample_kw = dict(
         temperature=args.temperature,
         key=jax.random.key(args.seed) if args.temperature > 0 else None,
         top_k=args.top_k,
         top_p=args.top_p,
     )
+    if mesh_cfg is not None:
+        gen = (
+            decode.generate_tp if mesh_cfg.tensor > 1
+            else decode.generate_fsdp
+        )
+        out = gen(
+            params, jax.numpy.asarray(ids), cfg, mesh_cfg,
+            args.max_new_tokens, **sample_kw,
+        )
+    else:
+        out = decode.generate(
+            params, jax.numpy.asarray(ids), cfg, args.max_new_tokens,
+            **sample_kw,
+        )
     out = np.asarray(jax.device_get(out))[0]
     if tok is not None:
         print(tok.decode(out.tolist()))

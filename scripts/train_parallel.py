@@ -52,7 +52,12 @@ def parse_mesh(spec: str) -> dict:
             raise SystemExit(
                 f"unknown mesh axis {name!r}; known: {', '.join(_AXES)}"
             )
-        out[name] = int(val)
+        try:
+            out[name] = int(val)
+        except ValueError:
+            raise SystemExit(
+                f"bad mesh axis size {part!r}: expected {name}=<int>"
+            ) from None
     return out
 
 
