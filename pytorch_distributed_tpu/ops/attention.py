@@ -96,15 +96,14 @@ def multi_head_attention(
     ``seq_impl`` picks the technique: "ring" (KV blocks stream around a
     ppermute ring, online-softmax merge) or "ulysses" (head/sequence
     all-to-all re-shard, full local attention — needs the axis to divide
-    the head counts). Attention dropout is unsupported under sequence
-    sharding (the reference has no sequence parallelism at all,
-    SURVEY.md §5.7).
+    the head counts). Attention dropout works under "ulysses" (the local
+    attention IS the full-sequence computation on this shard's head group
+    — see ops/ulysses.py for the per-shard-key contract) but not "ring",
+    where weights only ever exist per KV block inside the online-softmax
+    merge. (The reference has no sequence parallelism at all, SURVEY.md
+    §5.7.)
     """
     if seq_axis is not None:
-        if not deterministic and dropout_rate > 0.0:
-            raise NotImplementedError(
-                "attention dropout is not supported with sequence parallelism"
-            )
         if seq_impl == "ulysses":
             from pytorch_distributed_tpu.ops.ulysses import ulysses_attention
 
@@ -114,7 +113,10 @@ def multi_head_attention(
             # sequence parallelism exists to avoid. "naive" is promoted to
             # flash (same math up to online-softmax reordering); an
             # explicit impl="flash" passes through unchanged.
-            if impl == "naive":
+            # (No promotion note when attention dropout is active — the
+            # local backend falls back to naive there anyway, see
+            # ops/ulysses.py.)
+            if impl == "naive" and (deterministic or dropout_rate == 0.0):
                 import warnings
 
                 warnings.warn(
@@ -126,10 +128,19 @@ def multi_head_attention(
             return ulysses_attention(
                 q, k, v, axis_name=seq_axis, causal=causal,
                 impl="flash" if impl == "naive" else impl,
+                dropout_rate=dropout_rate,
+                dropout_key=dropout_key,
+                deterministic=deterministic,
             )
         if seq_impl != "ring":
             raise KeyError(
                 f"unknown seq_impl {seq_impl!r}; known: ring, ulysses"
+            )
+        if not deterministic and dropout_rate > 0.0:
+            raise NotImplementedError(
+                "attention dropout is not supported with ring attention "
+                "(weights exist only per KV block inside the online-softmax "
+                "merge); use seq_impl='ulysses' or attn_pdrop=0.0"
             )
         from pytorch_distributed_tpu.ops.ring_attention import ring_attention
 

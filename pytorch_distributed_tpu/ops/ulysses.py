@@ -48,6 +48,9 @@ def ulysses_attention(
     axis_name: str,
     causal: bool = True,
     impl: str = "naive",
+    dropout_rate: float = 0.0,
+    dropout_key: jax.Array | None = None,
+    deterministic: bool = True,
 ) -> jax.Array:
     """Sequence-parallel attention via head/sequence all-to-all re-sharding.
 
@@ -55,6 +58,19 @@ def ulysses_attention(
     Returns [B, T_local, H, D] with the same sharding as ``q``. ``impl``
     picks the LOCAL full-sequence backend: "flash" (blockwise/Pallas,
     O(T) memory — what long context needs) or "naive" (O(T^2) scores).
+
+    Attention dropout: after the re-shard the local weights cover the
+    FULL sequence for this shard's own head group, so a mask drawn from
+    a per-shard key is single-device dropout on those heads. The shard's
+    axis index is folded into ``dropout_key`` HERE (self-contained — a
+    replicated caller key would otherwise give every head group the
+    identical mask, correlated in a way the single-device [B, H, T, T]
+    draw never is; the extra fold on the already-per-shard keys the
+    shard_map training paths pass is statistically harmless). Head groups
+    on different shards therefore draw INDEPENDENT masks — together
+    statistically equivalent to the single-device draw. The local backend
+    falls back to naive when dropout is active (flash has no dropout
+    support — the same fallback the single-device dispatch makes).
     """
     n = jax.lax.psum(1, axis_name)
     h, hkv = q.shape[2], k.shape[2]
@@ -70,12 +86,23 @@ def ulysses_attention(
     # Full-sequence attention on the local head group — exactly the
     # single-device math (GQA group structure is preserved: H/n query
     # heads over Hkv/n KV heads keeps the same group size).
-    if impl == "flash":
+    dropout_active = not deterministic and dropout_rate > 0.0
+    if dropout_active and dropout_key is not None:
+        dropout_key = jax.random.fold_in(
+            dropout_key, jax.lax.axis_index(axis_name)
+        )
+    if impl == "flash" and not dropout_active:
         from pytorch_distributed_tpu.ops.pallas_flash import flash_attention
 
         out = flash_attention(qh, kh, vh, causal=causal)
     else:
         from pytorch_distributed_tpu.ops.attention import naive_attention
 
-        out = naive_attention(qh, kh, vh, causal=causal)
+        out = naive_attention(
+            qh, kh, vh,
+            causal=causal,
+            dropout_rate=dropout_rate,
+            dropout_key=dropout_key,
+            deterministic=deterministic,
+        )
     return _seq_to_heads(out, axis_name)

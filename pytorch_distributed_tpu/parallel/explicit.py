@@ -137,12 +137,22 @@ def make_explicit_train_step(
     # default nonzero — must not be spuriously rejected for seq/tensor
     # meshes it trains identically on.
     _gpt2 = model_cfg.family == "gpt2"
-    if _gpt2 and seq_axis is not None and model_cfg.attn_pdrop > 0:
-        # Fail at build time, not mid-trace on the first step (ring attention
-        # has no attention-dropout support, ops/attention.py).
+    if (
+        _gpt2
+        and seq_axis is not None
+        and model_cfg.attn_pdrop > 0
+        and model_cfg.seq_impl != "ulysses"
+    ):
+        # Fail at build time, not mid-trace on the first step. Ulysses IS
+        # supported: its local attention covers the full sequence for the
+        # shard's own head group, and fold_batch_shard_key already gives
+        # each seq shard an independent key (ops/ulysses.py). Ring has no
+        # attention-dropout support (weights only exist per KV block
+        # inside the online-softmax merge).
         raise NotImplementedError(
-            "attention dropout is not supported with sequence parallelism "
-            f"(attn_pdrop={model_cfg.attn_pdrop}); set attn_pdrop=0.0"
+            "attention dropout is not supported with ring-attention "
+            f"sequence parallelism (attn_pdrop={model_cfg.attn_pdrop}); "
+            "set attn_pdrop=0.0 or use seq_impl='ulysses'"
         )
     if (
         _gpt2

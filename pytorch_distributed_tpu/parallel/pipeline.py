@@ -235,14 +235,21 @@ def make_pipeline_train_step(
             "attention dropout with in-stage tensor parallelism needs "
             "cfg.tensor_dropout='folded' (or attn_pdrop=0.0)"
         )
-    if train_mode and mesh_cfg.seq > 1 and model_cfg.attn_pdrop > 0:
-        # Ring/ulysses attention has no attention-dropout support
-        # (ops/attention.py) — same build-time contract as the explicit
-        # path's seq check.
+    if (
+        train_mode
+        and mesh_cfg.seq > 1
+        and model_cfg.attn_pdrop > 0
+        and model_cfg.seq_impl != "ulysses"
+    ):
+        # Same build-time contract as the explicit path's seq check:
+        # ulysses supports attention dropout (per-seq-shard keys via
+        # fold_batch_shard_key, ops/ulysses.py); ring does not (weights
+        # only exist per KV block inside the online-softmax merge).
         raise NotImplementedError(
-            "attention dropout is not supported with in-stage sequence "
-            f"parallelism (attn_pdrop={model_cfg.attn_pdrop}); set "
-            "attn_pdrop=0.0"
+            "attention dropout is not supported with in-stage ring-"
+            f"attention sequence parallelism (attn_pdrop="
+            f"{model_cfg.attn_pdrop}); set attn_pdrop=0.0 or use "
+            "seq_impl='ulysses'"
         )
     if mesh_cfg.expert > 1:
         if not model_cfg.n_experts:

@@ -93,8 +93,10 @@ def main() -> int:
     )
     p.add_argument(
         "--no-dropout", action="store_true",
-        help="zero all dropout (forced for seq>1; ring attention has no "
-             "dropout support)",
+        help="zero all dropout (without it, only attn_pdrop is zeroed and "
+             "only for ring seq parallelism on the explicit/pipeline "
+             "paths — ring attention has no attention-dropout support; "
+             "ulysses and the auto path train with full dropout)",
     )
     args = p.parse_args()
     setup_platform(args)
@@ -142,12 +144,27 @@ def main() -> int:
                 "NamedSharding and never calls the CP kernels)"
             )
         model_cfg = model_cfg.replace(seq_impl=args.seq_impl)
-    if args.no_dropout or mesh_cfg.seq > 1:
-        # seq still requires it (ring attention has no dropout support);
-        # the pipeline path trains with dropout since round 4.
+    if args.no_dropout:
         model_cfg = model_cfg.replace(
             embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0
         )
+    elif (
+        mesh_cfg.seq > 1
+        and args.path in ("explicit", "pipeline")
+        and model_cfg.seq_impl == "ring"
+        and model_cfg.attn_pdrop > 0
+    ):
+        # Ring attention has no attention-dropout support (weights only
+        # exist per KV block inside the online-softmax merge); embd/resid
+        # dropout train fine under seq (per-shard folded keys), and
+        # Ulysses supports attention dropout too — so only this one
+        # combination is zeroed (round 5; was a blanket all-dropout zero
+        # for any seq mesh).
+        log.info(
+            "ring seq parallelism: attn_pdrop zeroed (no attention-"
+            "dropout support; --seq-impl ulysses keeps it)"
+        )
+        model_cfg = model_cfg.replace(attn_pdrop=0.0)
 
     dp = data_parallel_size(mesh_cfg)
     train_cfg = build_train_cfg(args, data_parallel_size=dp)

@@ -161,3 +161,37 @@ def test_pipeline_seq_embd_dropout_trains(eight_devices):
     )
     _, dm = dstep(dstate, batch, jax.random.key(0))
     assert abs(float(m["loss"]) - float(dm["loss"])) > 1e-4
+
+
+def test_pipeline_seq_ulysses_attn_dropout_trains(eight_devices):
+    """Attention dropout composes with in-stage ULYSSES seq parallelism
+    (round 5: the blanket seq refusal narrowed to ring): the local
+    attention covers the full sequence for each shard's head group and
+    fold_batch_shard_key gives each seq shard an independent key. The
+    step runs and the dropout provably engages."""
+    import numpy as np
+
+    case = build_case(
+        "gpt2", with_ref=False, attn_pdrop=0.5, seq_impl="ulysses",
+    )
+    cfg, model, tx, batch = (
+        case["cfg"], case["model"], case["tx"], case["batch"]
+    )
+    mcfg = MeshConfig(pipe=2, seq=2, strategy="no_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state)
+    _, m = step(state, batch, jax.random.key(0))
+    assert np.isfinite(float(m["loss"]))
+
+    det = build_case("gpt2", with_ref=False, seq_impl="ulysses")
+    dstate = init_train_state(
+        det["model"].init(domain_key(42, "init"), det["cfg"]), tx
+    )
+    dstate, _ = shard_pipeline_state(dstate, mesh, mcfg)
+    dstep = make_pipeline_train_step(
+        det["model"], det["cfg"], tx, mesh, mcfg, dstate
+    )
+    _, dm = dstep(dstate, batch, jax.random.key(0))
+    assert abs(float(m["loss"]) - float(dm["loss"])) > 1e-4

@@ -170,3 +170,180 @@ def test_ulysses_flash_backend_matches_naive(seq_mesh):
     np.testing.assert_allclose(
         np.asarray(fn(q, k, v)), np.asarray(ref), atol=1e-5
     )
+
+
+# -- attention dropout under ulysses (round-5: was a blanket seq refusal) --
+
+
+def test_ulysses_attention_dropout_moments(seq_mesh):
+    """ulysses_attention folds the shard's axis index into the dropout key
+    ITSELF (self-contained: even a replicated caller key — passed here —
+    gives each shard's head group independent masks over the FULL
+    sequence), statistically equivalent to the single-device [B, H, T, T]
+    draw: attention output is linear in the dropped softmax weights, so
+    the mean over draws converges to the deterministic output (inverted
+    dropout is unbiased), with nonzero per-draw variance proving the
+    masks engage."""
+    q, k, v = _qkv(seed=11)
+    det = naive_attention(q, k, v, causal=True)
+
+    def local(qs, ks, vs, key):
+        return ulysses_attention(
+            qs, ks, vs, axis_name="seq", causal=True,
+            dropout_rate=0.3, dropout_key=key, deterministic=False,
+        )
+
+    spec = P(None, "seq", None, None)
+    fn = jax.jit(
+        shard_map(
+            local, mesh=seq_mesh,
+            in_specs=(spec, spec, spec, P()),
+            out_specs=spec,
+        )
+    )
+    n = 512
+    total = np.zeros(det.shape, np.float64)
+    var_probe = []
+    for i in range(n):
+        out = np.asarray(fn(q, k, v, jax.random.key(i)))
+        total += out
+        if i < 8:
+            var_probe.append(out)
+    mean = total / n
+    # T=32 has 4x the elements of the TP moments test's T=8, so the max-
+    # order statistic is noisier; p99 + mean-|diff| are the stable
+    # unbiasedness checks at this size (single-device dropout with the
+    # same n shows the same max deviation, ~0.14).
+    diff = np.abs(mean - np.asarray(det))
+    assert float(np.percentile(diff, 99)) < 0.1
+    assert float(diff.mean()) < 0.03
+    assert float(np.std(np.stack(var_probe), axis=0).max()) > 0.05
+
+
+def test_ulysses_attention_dropout_cross_shard_independence(seq_mesh):
+    """Direct mask-independence probe: with q/k/v IDENTICAL across the
+    head dim, the deterministic output is identical for every head, so
+    under dropout two heads produce different outputs iff their masks
+    differ. With a replicated caller key (the internal axis-index fold is
+    what decorrelates), heads living on DIFFERENT seq shards must draw
+    different masks."""
+    rng = np.random.default_rng(21)
+    qh = rng.standard_normal((B, T, 1, D))
+    kh = rng.standard_normal((B, T, 1, D))
+    vh = rng.standard_normal((B, T, 1, D))
+    q, k, v = (
+        jnp.asarray(np.broadcast_to(x, (B, T, H, D)), jnp.float32)
+        for x in (qh, kh, vh)
+    )
+
+    def local(qs, ks, vs, key):
+        return ulysses_attention(
+            qs, ks, vs, axis_name="seq", causal=True,
+            dropout_rate=0.5, dropout_key=key, deterministic=False,
+        )
+
+    spec = P(None, "seq", None, None)
+    fn = jax.jit(
+        shard_map(
+            local, mesh=seq_mesh,
+            in_specs=(spec, spec, spec, P()),
+            out_specs=spec,
+        )
+    )
+    out = np.asarray(fn(q, k, v, jax.random.key(0)))  # [B, T, H, D]
+    # 8 shards x 1 head each: every pair of heads lives on different
+    # shards. Row 0 of causal attention has a single weight, so compare
+    # later rows where dropout has support.
+    h0, h1 = out[:, 8:, 0, :], out[:, 8:, 1, :]
+    assert float(np.abs(h0 - h1).max()) > 1e-3
+
+
+def test_explicit_ulysses_attn_dropout_step_runs(eight_devices):
+    """The explicit seq-parallel train step ACCEPTS attention dropout with
+    seq_impl='ulysses', runs, and the dropout provably engages (loss
+    differs from the deterministic config's)."""
+    from pytorch_distributed_tpu.config import (
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh, shard_train_state
+    from pytorch_distributed_tpu.parallel.explicit import (
+        make_explicit_train_step,
+    )
+    from pytorch_distributed_tpu.parallel.mesh import make_batch_put
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = ModelConfig(
+        vocab_size=128, n_ctx=32, n_embd=64, n_layer=2, n_head=4,
+        dtype="float32", embd_pdrop=0.0, attn_pdrop=0.5, resid_pdrop=0.0,
+        seq_impl="ulysses",
+    )
+    tcfg = TrainConfig(
+        global_batch_size=8, micro_batch_size=8, num_steps=1,
+        learning_rate=1e-3,
+    )
+    model = get_model(cfg)
+    tx = make_optimizer(tcfg)
+    rng = np.random.default_rng(3)
+    batch = {
+        "inputs": rng.integers(0, 128, (1, 8, 32)).astype(np.int32),
+        "targets": rng.integers(0, 128, (1, 8, 32)).astype(np.int32),
+    }
+    mcfg = MeshConfig(data=2, seq=2, strategy="no_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(13, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
+    _, m = step(state, make_batch_put(mesh, mcfg)(batch), jax.random.key(0))
+    assert np.isfinite(float(m["loss"])) and float(m["grad_norm"]) > 0
+
+    det_cfg = cfg.replace(attn_pdrop=0.0)
+    det_model = get_model(det_cfg)
+    dstate = init_train_state(
+        det_model.init(domain_key(13, "init"), det_cfg), tx
+    )
+    dstate, _ = shard_train_state(dstate, mesh, mcfg)
+    dstep = make_explicit_train_step(
+        det_model, det_cfg, tx, mesh, mcfg, dstate
+    )
+    _, dm = dstep(dstate, make_batch_put(mesh, mcfg)(batch), jax.random.key(0))
+    assert abs(float(m["loss"]) - float(dm["loss"])) > 1e-4
+
+
+def test_explicit_ring_attn_dropout_still_rejected(eight_devices):
+    """seq_impl='ring' (the default) still refuses attention dropout at
+    build time — weights only exist per KV block inside the online-softmax
+    merge."""
+    from pytorch_distributed_tpu.config import (
+        MeshConfig,
+        ModelConfig,
+        TrainConfig,
+    )
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh, shard_train_state
+    from pytorch_distributed_tpu.parallel.explicit import (
+        make_explicit_train_step,
+    )
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = ModelConfig(
+        vocab_size=128, n_ctx=32, n_embd=64, n_layer=2, n_head=4,
+        dtype="float32", embd_pdrop=0.0, attn_pdrop=0.1, resid_pdrop=0.0,
+        seq_impl="ring",
+    )
+    tx = make_optimizer(TrainConfig(
+        global_batch_size=8, micro_batch_size=8, num_steps=1,
+    ))
+    model = get_model(cfg)
+    mcfg = MeshConfig(seq=2, strategy="no_shard")
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(13, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    with pytest.raises(NotImplementedError, match="ring"):
+        make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
