@@ -192,6 +192,18 @@ _HF_LLAMA_BLOCK_KEYS = {
 }
 
 
+# Mixtral expert FFN naming -> our expert leaves. Mixtral computes
+# w2(silu(w1(x)) * w3(x)) per expert; our gated expert computes
+# (act(x@w_gate) * (x@w_in)) @ w_out (ops/moe._expert_compute), so w1 is
+# the activated gate side, w3 the multiplicative up side, w2 the down
+# projection.
+_MIXTRAL_EXPERT_KEYS = {
+    "w_gate": "w1",
+    "w_in": "w3",
+    "w_out": "w2",
+}
+
+
 def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
     """Convert an HF LlamaForCausalLM state dict to our llama params.
 
@@ -200,12 +212,19 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
     match HF exactly (ops/rope.py), so no permutations are needed. Tied-
     embedding checkpoints (no ``lm_head.weight``, e.g. Llama-3.2 1B) reuse
     ``embed_tokens`` for the head.
+
+    MoE configs (``cfg.n_experts > 0``) import Mixtral-style checkpoints:
+    ``block_sparse_moe.gate`` becomes the router and the per-expert
+    w1/w3/w2 Linears stack into our [L, X, D, F] / [L, X, F, D] expert
+    leaves (``_MIXTRAL_EXPERT_KEYS``). Mixtral's routing — top-k over a
+    full softmax, renormalised — is EXACTLY ops/moe._route's top_k>1
+    gating (softmax is monotonic, so top-k of probs = top-k of logits,
+    and renormalised top-k probs = softmax over the top-k logits), so
+    logits parity holds; set cfg.expert_capacity_factor >=
+    n_experts/moe_top_k — the exact no-drop bound (capacity scales with
+    the k*T assignment count, and each token sends at most ONE assignment
+    per expert) — for the dense per-token gather HF implements.
     """
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "HF llama import targets dense checkpoints; MoE configs "
-            "(n_experts > 0) have no HF-side weight mapping here"
-        )
     sd = {k: _to_np(v) for k, v in sd.items()}
     sd = {
         (k[len("model.") :] if k.startswith("model.") else k): v
@@ -227,7 +246,14 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
         "blocks": {},
     }
 
-    for hf_key, path in _HF_LLAMA_BLOCK_KEYS.items():
+    block_keys = dict(_HF_LLAMA_BLOCK_KEYS)
+    if cfg.n_experts:
+        # Mixtral layers have no dense mlp.* Linears; the MoE leaves are
+        # stacked separately below.
+        block_keys = {
+            k: v for k, v in block_keys.items() if v[0] != "mlp"
+        }
+    for hf_key, path in block_keys.items():
         per_layer = []
         for layer in range(cfg.n_layer):
             name = f"layers.{layer}.{hf_key}"
@@ -240,6 +266,43 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
         _set_nested(
             params["blocks"], path, np.stack(per_layer).astype(dtype)
         )
+
+    if cfg.n_experts:
+        moe = "block_sparse_moe"
+
+        def fetch(name: str) -> np.ndarray:
+            # Same missing-key diagnostic as the dense-key loop above, so
+            # truncated/mismatched checkpoints (e.g. cfg.n_experts larger
+            # than the checkpoint's) fail with the established message.
+            if name not in sd:
+                raise KeyError(f"missing {name!r} in state dict")
+            return sd[name]
+
+        # Router: gate.weight is a torch Linear [X, D] -> our [L, D, X].
+        params["blocks"]["mlp"] = {
+            "router": np.stack([
+                fetch(f"layers.{i}.{moe}.gate.weight").T
+                for i in range(cfg.n_layer)
+            ]).astype(dtype)
+        }
+        for ours, hf_w in _MIXTRAL_EXPERT_KEYS.items():
+            # Per-expert torch Linears [out, in] -> transposed and stacked
+            # over experts then layers: [L, X, in, out].
+            stacked = np.stack([
+                np.stack([
+                    fetch(f"layers.{i}.{moe}.experts.{j}.{hf_w}.weight").T
+                    for j in range(cfg.n_experts)
+                ])
+                for i in range(cfg.n_layer)
+            ]).astype(dtype)
+            params["blocks"]["mlp"][ours] = stacked
+        got_r = params["blocks"]["mlp"]["router"].shape
+        expect_r = (cfg.n_layer, cfg.n_embd, cfg.n_experts)
+        if got_r != expect_r:
+            raise ValueError(
+                f"router stacked shape {got_r} != {expect_r} — config "
+                "n_experts mismatch with the checkpoint"
+            )
 
     got = params["blocks"]["attn"]["wk"].shape
     expect = (cfg.n_layer, cfg.n_embd, cfg.kv_heads * cfg.head_dim)
@@ -263,13 +326,13 @@ def from_hf_pretrained(model_name: str = "gpt2", cfg: ModelConfig | None = None)
     from pytorch_distributed_tpu.config import model_config
 
     hf_cfg = AutoConfig.from_pretrained(model_name)
-    is_llama = hf_cfg.model_type in ("llama", "mistral")
-    # Mistral checkpoints use sliding-window attention, which this model
-    # family does not implement (full causal attention only). Beyond the
-    # window the two attention patterns diverge, so the usable context is
-    # clamped to the window; logits within it match HF exactly.
+    is_llama = hf_cfg.model_type in ("llama", "mistral", "mixtral")
+    # Mistral-family checkpoints may use sliding-window attention, which
+    # this model family does not implement (full causal attention only).
+    # Beyond the window the two attention patterns diverge, so the usable
+    # context is clamped to the window; logits within it match HF exactly.
     sliding = getattr(hf_cfg, "sliding_window", None)
-    if hf_cfg.model_type == "mistral" and sliding:
+    if hf_cfg.model_type in ("mistral", "mixtral") and sliding:
         if cfg is not None and cfg.n_ctx > int(sliding):
             # An explicit cfg must stay within the window: beyond it the
             # full-causal logits silently diverge from HF, so refuse
@@ -291,7 +354,7 @@ def from_hf_pretrained(model_name: str = "gpt2", cfg: ModelConfig | None = None)
     if cfg is None:
         if is_llama:
             n_ctx = hf_cfg.max_position_embeddings
-            if hf_cfg.model_type == "mistral" and sliding:
+            if hf_cfg.model_type in ("mistral", "mixtral") and sliding:
                 n_ctx = min(n_ctx, int(sliding))
             cfg = model_config("llama3-1b").replace(
                 vocab_size=hf_cfg.vocab_size,
@@ -304,6 +367,20 @@ def from_hf_pretrained(model_name: str = "gpt2", cfg: ModelConfig | None = None)
                 rope_theta=hf_cfg.rope_theta,
                 layer_norm_epsilon=hf_cfg.rms_norm_eps,
             )
+            if hf_cfg.model_type == "mixtral":
+                # Sparse-MoE shape: capacity at the exact no-drop bound
+                # (cf = X/k gives cap = T slots per expert; each token
+                # contributes at most one assignment per expert) so our
+                # capacity-based dispatch reproduces HF's dense per-token
+                # gather exactly with no padding waste.
+                cfg = cfg.replace(
+                    n_experts=hf_cfg.num_local_experts,
+                    moe_top_k=hf_cfg.num_experts_per_tok,
+                    expert_capacity_factor=(
+                        float(hf_cfg.num_local_experts)
+                        / hf_cfg.num_experts_per_tok
+                    ),
+                )
         else:
             cfg = model_config("gpt2").replace(
                 vocab_size=hf_cfg.vocab_size,
