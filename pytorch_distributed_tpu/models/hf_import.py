@@ -268,6 +268,18 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
         )
 
     if cfg.n_experts:
+        if cfg.moe_top_k == 1:
+            # ops/moe._route uses Switch gating at top_k=1 (expert output
+            # scaled by the RAW softmax prob); Mixtral renormalises the
+            # selected prob to 1.0. The two differ exactly at k=1, so a
+            # silent import would break the parity contract. Every
+            # released Mixtral uses k=2.
+            raise ValueError(
+                "Mixtral import needs moe_top_k >= 2: at top_k=1 our "
+                "Switch gating (raw prob) differs from Mixtral's "
+                "renormalised gating (weight 1.0), so HF parity is "
+                "impossible"
+            )
         moe = "block_sparse_moe"
 
         def fetch(name: str) -> np.ndarray:
@@ -312,6 +324,59 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
             "mismatch with the checkpoint"
         )
     return params
+
+
+def to_hf_llama_state_dict(params: dict) -> dict:
+    """Export our llama-family params to HF naming (torch-Linear [out, in]
+    layout, ``model.``-prefixed) — the inverse of
+    ``from_hf_llama_state_dict``, for both dense and Mixtral-style MoE
+    trees (detected from the params: a ``blocks/mlp/router`` leaf means
+    sparse-MoE naming). Produces numpy arrays; wrap in torch tensors to
+    load into a transformers model."""
+    blocks = params["blocks"]
+    out = {
+        "model.embed_tokens.weight": np.asarray(params["wte"]),
+        "model.norm.weight": np.asarray(params["ln_f"]["scale"]),
+        "lm_head.weight": np.asarray(params["lm_head"]).T,
+    }
+
+    def get(path):
+        node = blocks
+        for p in path:
+            node = node[p]
+        return np.asarray(node)
+
+    n_layer = get(("ln_attn", "scale")).shape[0]
+    moe = "router" in blocks.get("mlp", {})
+    block_keys = {
+        k: v for k, v in _HF_LLAMA_BLOCK_KEYS.items()
+        if not (moe and v[0] == "mlp")
+    }
+    for hf_key, path in block_keys.items():
+        stacked = get(path)
+        for layer in range(n_layer):
+            arr = stacked[layer]
+            if hf_key.endswith("proj.weight"):
+                arr = arr.T  # kernel [in, out] -> Linear [out, in]
+            out[f"model.layers.{layer}.{hf_key}"] = arr
+    if moe:
+        router = get(("mlp", "router"))  # [L, D, X]
+        n_experts = router.shape[-1]
+        # One device-to-host materialisation per expert leaf, not per
+        # layer (an 8x7B-scale stack is multi-GB).
+        expert_stacks = {
+            ours: get(("mlp", ours))  # [L, X, in, out]
+            for ours in _MIXTRAL_EXPERT_KEYS
+        }
+        for layer in range(n_layer):
+            base = f"model.layers.{layer}.block_sparse_moe"
+            out[f"{base}.gate.weight"] = router[layer].T
+            for ours, hf_w in _MIXTRAL_EXPERT_KEYS.items():
+                for j in range(n_experts):
+                    out[f"{base}.experts.{j}.{hf_w}.weight"] = (
+                        expert_stacks[ours][layer, j].T
+                    )
+    return out
 
 
 def from_hf_pretrained(model_name: str = "gpt2", cfg: ModelConfig | None = None):
