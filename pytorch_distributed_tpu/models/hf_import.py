@@ -332,13 +332,21 @@ def to_hf_llama_state_dict(params: dict) -> dict:
     ``from_hf_llama_state_dict``, for both dense and Mixtral-style MoE
     trees (detected from the params: a ``blocks/mlp/router`` leaf means
     sparse-MoE naming). Produces numpy arrays; wrap in torch tensors to
-    load into a transformers model."""
+    load into a transformers model.
+
+    Tied-embedding checkpoints import with ``lm_head`` aliased to the
+    embedding table; the export detects that (head.T == wte) and omits
+    ``lm_head.weight`` the way the tied HF checkpoint does, keeping
+    export(import(sd)) == sd exactly for tied checkpoints too."""
     blocks = params["blocks"]
+    wte = np.asarray(params["wte"])
+    head = np.asarray(params["lm_head"]).T
     out = {
-        "model.embed_tokens.weight": np.asarray(params["wte"]),
+        "model.embed_tokens.weight": wte,
         "model.norm.weight": np.asarray(params["ln_f"]["scale"]),
-        "lm_head.weight": np.asarray(params["lm_head"]).T,
     }
+    if not np.array_equal(head, wte):
+        out["lm_head.weight"] = head
 
     def get(path):
         node = blocks

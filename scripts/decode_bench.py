@@ -28,7 +28,8 @@ from _common import setup_platform  # noqa: E402  (bootstraps the repo root)
 
 
 def bench_decode(preset: str, batch: int, prompt_len: int,
-                 n1: int, n2: int, repeats: int) -> dict:
+                 n1: int, n2: int, repeats: int,
+                 n_experts: int = 0, moe_top_k: int = 1) -> dict:
     import jax
     import numpy as np
 
@@ -42,6 +43,13 @@ def bench_decode(preset: str, batch: int, prompt_len: int,
         embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
         n_ctx=min(model_config(preset).n_ctx, prompt_len + n2),
     )
+    if n_experts:
+        # No-drop capacity (cf = X/k), the inference convention — see
+        # models/decode._moe_mlp.
+        cfg = cfg.replace(
+            n_experts=n_experts, moe_top_k=moe_top_k,
+            expert_capacity_factor=float(n_experts) / moe_top_k,
+        )
     model = get_model(cfg)
     params = model.init(domain_key(seed, "init"), cfg)
     rng = np.random.default_rng(seed)
@@ -68,6 +76,8 @@ def bench_decode(preset: str, batch: int, prompt_len: int,
     med = sorted(rates)[len(rates) // 2]
     return dict(
         preset=preset,
+        n_experts=n_experts,
+        moe_top_k=moe_top_k if n_experts else None,
         batch=batch,
         prompt_len=prompt_len,
         incremental_tokens_per_sec=round(med, 1),
@@ -86,6 +96,10 @@ def main() -> int:
     ap.add_argument("--n1", type=int, default=32)
     ap.add_argument("--n2", type=int, default=160)
     ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--n-experts", type=int, default=0,
+                    help="bench an MoE variant of the preset (Switch/top-k "
+                         "routing; capacity at the no-drop bound)")
+    ap.add_argument("--moe-top-k", type=int, default=1)
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force CPU platform with this many virtual devices "
                          "(cluster-free smoke; throughput not meaningful)")
@@ -96,7 +110,7 @@ def main() -> int:
     for preset in presets:
         res = bench_decode(
             preset, args.batch, args.prompt_len, args.n1, args.n2,
-            args.repeats,
+            args.repeats, args.n_experts, args.moe_top_k,
         )
         print(json.dumps(res))
     return 0

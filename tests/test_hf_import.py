@@ -351,3 +351,35 @@ def test_mixtral_import_topk1_rejected(hf_mixtral_and_cfg):
         from_hf_llama_state_dict(
             model.state_dict(), cfg.replace(moe_top_k=1)
         )
+
+
+def test_llama_export_tied_embedding_roundtrip():
+    """Tied-embedding checkpoints (no lm_head.weight) survive the
+    export(import(sd)) == sd invariant: the exporter detects the aliased
+    head and omits the key like the tied HF checkpoint does."""
+    from pytorch_distributed_tpu.models.hf_import import (
+        from_hf_llama_state_dict,
+        to_hf_llama_state_dict,
+    )
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=97, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=32, tie_word_embeddings=True,
+    )
+    torch.manual_seed(3)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig(
+        family="llama", vocab_size=97, n_ctx=32, n_embd=32, n_layer=2,
+        n_head=4, n_kv_head=2, n_inner=64, dtype="float32",
+        layer_norm_epsilon=hf_cfg.rms_norm_eps,
+    )
+    sd = {k: v.numpy() for k, v in model.state_dict().items()}
+    # Tied checkpoint FILES omit lm_head.weight (state_dict() may still
+    # carry the alias, depending on the transformers version — drop it to
+    # model the on-disk shape the importer documents).
+    sd.pop("lm_head.weight", None)
+    exported = to_hf_llama_state_dict(from_hf_llama_state_dict(sd, cfg))
+    assert set(exported) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(exported[k], sd[k], err_msg=k)
