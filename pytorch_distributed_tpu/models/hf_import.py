@@ -326,7 +326,7 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
     return params
 
 
-def to_hf_llama_state_dict(params: dict) -> dict:
+def to_hf_llama_state_dict(params: dict, *, tied: bool | None = None) -> dict:
     """Export our llama-family params to HF naming (torch-Linear [out, in]
     layout, ``model.``-prefixed) — the inverse of
     ``from_hf_llama_state_dict``, for both dense and Mixtral-style MoE
@@ -335,9 +335,13 @@ def to_hf_llama_state_dict(params: dict) -> dict:
     load into a transformers model.
 
     Tied-embedding checkpoints import with ``lm_head`` aliased to the
-    embedding table; the export detects that (head.T == wte) and omits
-    ``lm_head.weight`` the way the tied HF checkpoint does, keeping
-    export(import(sd)) == sd exactly for tied checkpoints too."""
+    embedding table; ``tied=None`` (default) detects that by value
+    (head.T == wte) and omits ``lm_head.weight`` the way the tied HF
+    checkpoint does, keeping export(import(sd)) == sd exactly for tied
+    checkpoints too. The value heuristic is coincidence-prone for an
+    UNTIED model whose head still equals its embedding (e.g. export
+    straight after a tied-style init) — pass ``tied=False`` (or True) to
+    decide explicitly."""
     blocks = params["blocks"]
     wte = np.asarray(params["wte"])
     head = np.asarray(params["lm_head"]).T
@@ -345,7 +349,9 @@ def to_hf_llama_state_dict(params: dict) -> dict:
         "model.embed_tokens.weight": wte,
         "model.norm.weight": np.asarray(params["ln_f"]["scale"]),
     }
-    if not np.array_equal(head, wte):
+    if tied is None:
+        tied = np.array_equal(head, wte)
+    if not tied:
         out["lm_head.weight"] = head
 
     def get(path):

@@ -87,6 +87,88 @@ def bench_decode(preset: str, batch: int, prompt_len: int,
     )
 
 
+def bench_speculative(preset: str, prompt_len: int, max_new: int,
+                      draft_len: int, ngram: int, repeats: int,
+                      n_experts: int = 0, moe_top_k: int = 1) -> dict:
+    """Plain vs prompt-lookup speculative greedy decode (B=1), same fresh
+    prompt per repeat. Greedy generation from a fixed model self-loops
+    quickly, so the lookup fires — the ratio measures the realistic
+    repetitive-text case; on incompressible text the ratio tends to ~1
+    minus the verify overhead."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.config import model_config
+    from pytorch_distributed_tpu.models import decode, get_model
+    from pytorch_distributed_tpu.models.speculative import (
+        generate_speculative,
+    )
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    seed = int.from_bytes(os.urandom(4), "little")
+    kw = dict(dtype="bfloat16", param_dtype="bfloat16")
+    cfg = model_config(preset, **kw).replace(
+        embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+        n_ctx=min(model_config(preset).n_ctx,
+                  prompt_len + max_new + draft_len),
+    )
+    if n_experts:
+        cfg = cfg.replace(
+            n_experts=n_experts, moe_top_k=moe_top_k,
+            expert_capacity_factor=float(n_experts) / moe_top_k,
+        )
+    model = get_model(cfg)
+    params = model.init(domain_key(seed, "init"), cfg)
+    rng = np.random.default_rng(seed)
+
+    def fresh_prompt():
+        return jax.numpy.asarray(
+            rng.integers(0, cfg.vocab_size, (1, prompt_len)),
+            jax.numpy.int32,
+        )
+
+    def run_plain(prompt):
+        t0 = time.perf_counter()
+        out = decode.generate(
+            params, prompt, cfg, max_new,
+            max_len=prompt_len + max_new + draft_len,
+        )
+        np.asarray(out)
+        return time.perf_counter() - t0
+
+    def run_spec(prompt):
+        t0 = time.perf_counter()
+        out = generate_speculative(
+            params, prompt, cfg, max_new, draft_len=draft_len, ngram=ngram,
+        )
+        np.asarray(out)
+        return time.perf_counter() - t0
+
+    warm = fresh_prompt()
+    run_plain(warm), run_spec(warm)  # compile both programs
+    ratios, plain_ts, spec_ts = [], [], []
+    for _ in range(repeats):
+        p = fresh_prompt()
+        tp_, ts_ = run_plain(p), run_spec(p)
+        plain_ts.append(tp_)
+        spec_ts.append(ts_)
+        ratios.append(tp_ / ts_)
+    med = sorted(ratios)[len(ratios) // 2]
+    return dict(
+        preset=preset,
+        mode="speculative",
+        n_experts=n_experts,
+        moe_top_k=moe_top_k if n_experts else None,
+        draft_len=draft_len,
+        ngram=ngram,
+        max_new=max_new,
+        plain_tokens_per_sec=round(max_new / np.median(plain_ts), 1),
+        speculative_tokens_per_sec=round(max_new / np.median(spec_ts), 1),
+        speedup=round(med, 3),
+        platform=jax.devices()[0].platform,
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default=None,
@@ -100,6 +182,13 @@ def main() -> int:
                     help="bench an MoE variant of the preset (Switch/top-k "
                          "routing; capacity at the no-drop bound)")
     ap.add_argument("--moe-top-k", type=int, default=1)
+    ap.add_argument("--speculative", type=int, default=0, metavar="K",
+                    help="instead of the batched bench, compare plain vs "
+                         "prompt-lookup speculative greedy decode (B=1) "
+                         "with draft_len=K (models/speculative.py)")
+    ap.add_argument("--ngram", type=int, default=2)
+    ap.add_argument("--max-new", type=int, default=512,
+                    help="generation length for --speculative")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force CPU platform with this many virtual devices "
                          "(cluster-free smoke; throughput not meaningful)")
@@ -108,10 +197,17 @@ def main() -> int:
 
     presets = [args.preset] if args.preset else ["gpt2", "llama3-1b"]
     for preset in presets:
-        res = bench_decode(
-            preset, args.batch, args.prompt_len, args.n1, args.n2,
-            args.repeats, args.n_experts, args.moe_top_k,
-        )
+        if args.speculative:
+            res = bench_speculative(
+                preset, args.prompt_len, args.max_new,
+                args.speculative, args.ngram, args.repeats,
+                args.n_experts, args.moe_top_k,
+            )
+        else:
+            res = bench_decode(
+                preset, args.batch, args.prompt_len, args.n1, args.n2,
+                args.repeats, args.n_experts, args.moe_top_k,
+            )
         print(json.dumps(res))
     return 0
 

@@ -383,3 +383,20 @@ def test_llama_export_tied_embedding_roundtrip():
     assert set(exported) == set(sd)
     for k in sd:
         np.testing.assert_array_equal(exported[k], sd[k], err_msg=k)
+
+
+def test_llama_export_tied_override(hf_llama_and_cfg):
+    """tied= overrides the value heuristic: an untied model whose head
+    coincidentally equals wte still exports lm_head.weight with
+    tied=False, and any model exports without it under tied=True."""
+    from pytorch_distributed_tpu.models.hf_import import (
+        from_hf_llama_state_dict,
+        to_hf_llama_state_dict,
+    )
+
+    model, cfg = hf_llama_and_cfg
+    params = dict(from_hf_llama_state_dict(model.state_dict(), cfg))
+    params["lm_head"] = np.asarray(params["wte"]).T  # head == wte by value
+    assert "lm_head.weight" not in to_hf_llama_state_dict(params)
+    assert "lm_head.weight" in to_hf_llama_state_dict(params, tied=False)
+    assert "lm_head.weight" not in to_hf_llama_state_dict(params, tied=True)

@@ -1,0 +1,93 @@
+"""Prompt-lookup speculative decoding (models/speculative.py).
+
+The load-bearing invariant: the speculative greedy output is BITWISE the
+plain greedy decode — draft quality changes speed only. Pinned on random
+prompts (drafts mostly rejected), repetitive prompts (drafts accepted),
+MoE configs, and across draft_len/ngram settings, for both families.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import ModelConfig
+from pytorch_distributed_tpu.models import decode, get_model
+from pytorch_distributed_tpu.models.speculative import generate_speculative
+
+pytestmark = pytest.mark.full
+
+
+def _cfg(family, **kw):
+    extra = {"n_kv_head": 2} if family == "llama" else {}
+    extra.update(kw)
+    return ModelConfig(
+        family=family, vocab_size=61, n_ctx=96, n_embd=64, n_layer=2,
+        n_head=4, dtype="float32", attn_pdrop=0.0, resid_pdrop=0.0,
+        embd_pdrop=0.0, **extra,
+    )
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_speculative_equals_greedy_random_prompt(family):
+    """Random prompt: lookup rarely matches, most drafts are rejected —
+    the rejection path must still reproduce plain greedy exactly."""
+    cfg = _cfg(family)
+    params = get_model(cfg).init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (1, 7), 0, cfg.vocab_size)
+    ref = decode.generate(params, prompt, cfg, 20)
+    got = generate_speculative(params, prompt, cfg, 20)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+def test_speculative_equals_greedy_repetitive_prompt(family):
+    """Repetitive prompt: the n-gram lookup fires and long drafts are
+    accepted — the acceptance path must also be exact."""
+    cfg = _cfg(family)
+    params = get_model(cfg).init(jax.random.key(2), cfg)
+    pat = np.array([[5, 9, 12, 5, 9, 12, 5, 9, 12, 5, 9]], np.int32)
+    prompt = jnp.asarray(pat)
+    ref = decode.generate(params, prompt, cfg, 24)
+    got = generate_speculative(params, prompt, cfg, 24)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.parametrize("draft_len,ngram", [(1, 1), (4, 2), (8, 3)])
+def test_speculative_settings_do_not_change_output(draft_len, ngram):
+    cfg = _cfg("gpt2")
+    params = get_model(cfg).init(jax.random.key(3), cfg)
+    prompt = jax.random.randint(jax.random.key(4), (1, 6), 0, cfg.vocab_size)
+    ref = decode.generate(params, prompt, cfg, 16)
+    got = generate_speculative(
+        params, prompt, cfg, 16, draft_len=draft_len, ngram=ngram
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_speculative_moe_equals_greedy():
+    """MoE verify forward: per-token routing inside the K+1-token forward
+    must agree with the one-token-at-a-time routing of plain decode."""
+    cfg = _cfg("gpt2", n_experts=4, moe_top_k=2, expert_capacity_factor=2.0)
+    params = get_model(cfg).init(jax.random.key(5), cfg)
+    pat = np.array([[3, 8, 3, 8, 3, 8, 3]], np.int32)
+    prompt = jnp.asarray(pat)
+    ref = decode.generate(params, prompt, cfg, 16)
+    got = generate_speculative(params, prompt, cfg, 16)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_speculative_rejects_bad_args():
+    cfg = _cfg("gpt2")
+    params = get_model(cfg).init(jax.random.key(6), cfg)
+    prompt2 = jnp.zeros((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="single-sequence"):
+        generate_speculative(params, prompt2, cfg, 4)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    with pytest.raises(ValueError, match="draft_len"):
+        generate_speculative(params, prompt, cfg, 4, draft_len=0)
+    with pytest.raises(ValueError, match="n_ctx"):
+        generate_speculative(params, prompt, cfg, cfg.n_ctx)
+    # max_new_tokens=0: the prompt is the output.
+    out = generate_speculative(params, prompt, cfg, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
