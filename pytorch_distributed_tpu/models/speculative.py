@@ -13,10 +13,15 @@ on self-repetitive text (code, extraction, summarisation with quotes).
 
 Exactness: the verifier accepts draft[j] only while every earlier draft
 matched the model's own greedy choice, then appends the model's next
-token itself. The output is therefore BITWISE the plain greedy decode —
-draft quality only changes speed. ``tests/test_speculative.py`` pins
-``generate_speculative(...) == decode.generate(...)`` on adversarial and
-repetitive inputs for both families.
+token itself — the output is a greedy decode of the model; draft quality
+only changes speed. In float32 it is BITWISE the plain
+``decode.generate`` output (``tests/test_speculative.py`` pins equality
+on adversarial and repetitive inputs for both families). In reduced
+precision (bf16) the 1-token and K+1-token forwards are differently
+shaped programs whose logits can round near-ties differently, so the two
+decodes may diverge AT a near-tie (measured on TPU; the same caveat
+applies to any speculative scheme, incl. HF's) — each output is still
+greedy for its own program's logits.
 
 TPU-first mechanics (everything static-shaped inside one jit):
 - the n-gram search is a vectorised compare over the fixed-size output
@@ -150,8 +155,10 @@ def generate_speculative(
 ) -> jax.Array:
     """Greedy generation with prompt-lookup speculative decoding.
 
-    Returns [1, Tp + max_new_tokens] — BITWISE identical to
-    ``decode.generate(..., temperature=0)``; drafts only change speed.
+    Returns [1, Tp + max_new_tokens] — bitwise identical to
+    ``decode.generate(..., temperature=0)`` in float32; in bf16 the two
+    programs may round near-tied logits differently (module docstring).
+    Drafts only change speed.
     ``draft_len`` (K) is the speculation depth: each loop iteration
     verifies K drafted tokens in one K+1-token forward and commits
     between 1 and K+1 tokens. ``ngram`` is the lookup width (2 is the

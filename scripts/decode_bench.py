@@ -133,26 +133,30 @@ def bench_speculative(preset: str, prompt_len: int, max_new: int,
             params, prompt, cfg, max_new,
             max_len=prompt_len + max_new + draft_len,
         )
-        np.asarray(out)
-        return time.perf_counter() - t0
+        return np.asarray(out), time.perf_counter() - t0
 
     def run_spec(prompt):
         t0 = time.perf_counter()
         out = generate_speculative(
             params, prompt, cfg, max_new, draft_len=draft_len, ngram=ngram,
         )
-        np.asarray(out)
-        return time.perf_counter() - t0
+        return np.asarray(out), time.perf_counter() - t0
 
     warm = fresh_prompt()
     run_plain(warm), run_spec(warm)  # compile both programs
-    ratios, plain_ts, spec_ts = [], [], []
+    ratios, plain_ts, spec_ts, matched = [], [], [], 0
     for _ in range(repeats):
         p = fresh_prompt()
-        tp_, ts_ = run_plain(p), run_spec(p)
+        out_p, tp_ = run_plain(p)
+        out_s, ts_ = run_spec(p)
         plain_ts.append(tp_)
         spec_ts.append(ts_)
         ratios.append(tp_ / ts_)
+        # Exactness check where the numbers are measured. bf16 runs may
+        # legitimately diverge at near-tied logits (the 1-token and
+        # K+1-token programs round differently — models/speculative.py
+        # module docstring), so this is REPORTED, not asserted.
+        matched += int(np.array_equal(out_p, out_s))
     med = sorted(ratios)[len(ratios) // 2]
     return dict(
         preset=preset,
@@ -165,6 +169,7 @@ def bench_speculative(preset: str, prompt_len: int, max_new: int,
         plain_tokens_per_sec=round(max_new / np.median(plain_ts), 1),
         speculative_tokens_per_sec=round(max_new / np.median(spec_ts), 1),
         speedup=round(med, 3),
+        outputs_match=f"{matched}/{repeats}",
         platform=jax.devices()[0].platform,
     )
 

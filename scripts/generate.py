@@ -70,11 +70,31 @@ def main() -> int:
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force a virtual N-device CPU platform (cluster-"
                          "free mesh runs, same as train_parallel.py)")
+    ap.add_argument("--speculative", type=int, default=0, metavar="K",
+                    help="greedy prompt-lookup speculative decoding with "
+                         "draft_len=K (models/speculative.py; bitwise the "
+                         "plain greedy decode in f32, near-ties may "
+                         "round differently in bf16 — only faster on "
+                         "self-repetitive text). Greedy-only, "
+                         "single-device.")
+    ap.add_argument("--ngram", type=int, default=2,
+                    help="lookup n-gram width for --speculative")
     args = ap.parse_args()
 
     from _common import setup_platform
 
     setup_platform(args)
+
+    if args.speculative and args.mesh:
+        raise SystemExit(
+            "--speculative is single-device (the verify loop owns the "
+            "cache offsets); drop --mesh"
+        )
+    if args.speculative and args.temperature > 0:
+        raise SystemExit(
+            "--speculative is greedy-only (temperature sampling needs "
+            "rejection-sampling corrections); drop --temperature"
+        )
 
     # Validate --mesh BEFORE any weight IO (an HF pull or checkpoint
     # restore can be multi-GB; a typo'd axis should not cost that).
@@ -192,6 +212,15 @@ def main() -> int:
         out = gen(
             params, jax.numpy.asarray(ids), cfg, mesh_cfg,
             args.max_new_tokens, **sample_kw,
+        )
+    elif args.speculative:
+        from pytorch_distributed_tpu.models.speculative import (
+            generate_speculative,
+        )
+
+        out = generate_speculative(
+            params, jax.numpy.asarray(ids), cfg, args.max_new_tokens,
+            draft_len=args.speculative, ngram=args.ngram,
         )
     else:
         out = decode.generate(
