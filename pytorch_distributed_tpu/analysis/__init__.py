@@ -1,0 +1,55 @@
+"""Static analysis of compiled programs: the `xprog` audit pass.
+
+Audits any jitted step function's jaxpr + optimized HLO without running
+it: collective budgets per parallelism strategy, donation/aliasing,
+dtype leaks, and recompilation/host-sync hazards. See docs/ANALYSIS.md.
+
+Entry points:
+- ``audit_program(fn, args, budget) -> AuditReport`` — library API;
+- ``scripts/audit.py --all`` — audit every registered strategy x model;
+- the ``audit`` pytest fixture (analysis/pytest_plugin.py);
+- ``python -m pytorch_distributed_tpu.analysis.repolint`` — repo-rule
+  AST lint (CI).
+"""
+
+from pytorch_distributed_tpu.analysis.audit import (
+    audit_program,
+    check_donation,
+    check_dtype,
+    check_hazards,
+)
+from pytorch_distributed_tpu.analysis.budget import (
+    NO_COLLECTIVES,
+    CollectiveBudget,
+    check_budget,
+    expected_budget,
+)
+from pytorch_distributed_tpu.analysis.hlo import (
+    HLO_COLLECTIVES,
+    collective_counts,
+    collective_instructions,
+    parse_input_output_aliases,
+)
+from pytorch_distributed_tpu.analysis.report import (
+    AuditReport,
+    Finding,
+    reports_to_json,
+)
+
+__all__ = [
+    "AuditReport",
+    "CollectiveBudget",
+    "Finding",
+    "HLO_COLLECTIVES",
+    "NO_COLLECTIVES",
+    "audit_program",
+    "check_budget",
+    "check_donation",
+    "check_dtype",
+    "check_hazards",
+    "collective_counts",
+    "collective_instructions",
+    "expected_budget",
+    "parse_input_output_aliases",
+    "reports_to_json",
+]

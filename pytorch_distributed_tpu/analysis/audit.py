@@ -1,0 +1,355 @@
+"""``audit_program``: static audit of a jitted step function.
+
+Takes any jitted (state, ...) -> (...) entry point plus example arguments,
+lowers + compiles it WITHOUT running it, and checks:
+
+1. collective budget — the compiled HLO emits exactly the collectives the
+   strategy's contract allows (analysis/budget.py);
+2. donation — the state argument's buffers are input/output-aliased in the
+   compiled module (donate_argnums was passed AND XLA accepted the
+   aliases; losing either silently double-buffers params+opt state);
+3. dtype leaks — no all-f32 matmuls in a program configured for bf16
+   compute, no back-to-back convert chains on the hot path (jaxpr-level:
+   XLA:CPU legalises bf16 dots to f32, so optimized HLO would lie here);
+4. recompilation / host-sync hazards — host callbacks
+   (``jax.debug.print`` / ``io_callback`` / ``pure_callback``) inside the
+   hot loop, weak-typed (Python-scalar) arguments that retrace when their
+   Python type changes.
+
+The checkers are pure functions over the lowered artifacts, so everything
+runs on the CPU test rig (``JAX_PLATFORMS=cpu`` + virtual devices) against
+the SAME HLO the TPU path compiles, modulo backend-specific late rewrites.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from pytorch_distributed_tpu.analysis.budget import (
+    CollectiveBudget,
+    check_budget,
+)
+from pytorch_distributed_tpu.analysis.hlo import (
+    aliased_param_numbers,
+    collective_instructions,
+)
+from pytorch_distributed_tpu.analysis.jaxpr_scan import (
+    JaxprSummary,
+    trace_summary,
+)
+from pytorch_distributed_tpu.analysis.report import AuditReport, Finding
+from pytorch_distributed_tpu.profiling.trace_analysis import classify_op
+
+ALL_CHECKS = ("collectives", "donation", "dtype", "hazards")
+
+
+def _leaf_count(tree) -> int:
+    return len(jax.tree.leaves(tree))
+
+
+def _program_summary(jitted, args) -> JaxprSummary | None:
+    """Jaxpr scan of a jitted program. Prefers ``jitted.trace(*args)``,
+    which respects static_argnums/static_argnames (``jax.make_jaxpr``
+    would feed tracers into the static slots and crash on e.g. the decode
+    entry points); falls back to make_jaxpr for plain callables, and to
+    None when neither can trace the signature."""
+    from pytorch_distributed_tpu.analysis.jaxpr_scan import scan_jaxpr
+
+    if hasattr(jitted, "trace"):
+        try:
+            return scan_jaxpr(jitted.trace(*args).jaxpr)
+        except Exception:
+            pass
+    try:
+        return trace_summary(jitted, args)
+    except Exception:
+        return None
+
+
+def check_donation(
+    hlo_text: str,
+    args: tuple,
+    donate_argnums: tuple[int, ...],
+    *,
+    memory_analysis=None,
+) -> tuple[list[Finding], dict]:
+    """Verify the donated arguments survived compilation as buffer aliases.
+
+    jit flattens positional arguments in order, so argument ``i``'s leaves
+    occupy a contiguous run of entry-parameter numbers; every one of them
+    should appear in the module header's ``input_output_alias`` map. A
+    missing run means donate_argnums was dropped at the call site; a
+    partial run means XLA rejected some aliases (shape/dtype mismatch
+    between the donated input and any output — the "donated buffer was not
+    usable" warning made machine-checkable).
+    """
+    aliased = aliased_param_numbers(hlo_text)
+    expected: set[int] = set()
+    offset = 0
+    for i, arg in enumerate(args):
+        n = _leaf_count(arg)
+        if i in donate_argnums:
+            expected |= set(range(offset, offset + n))
+        offset += n
+
+    stats = {
+        "expected": len(expected),
+        "aliased": len(expected & aliased),
+        "donate_argnums": list(donate_argnums),
+    }
+    if memory_analysis is not None:
+        stats["alias_bytes"] = int(memory_analysis.alias_size_in_bytes)
+        stats["argument_bytes"] = int(memory_analysis.argument_size_in_bytes)
+
+    findings: list[Finding] = []
+    if not expected:
+        return findings, stats
+    missing = expected - aliased
+    if len(missing) == len(expected):
+        findings.append(
+            Finding(
+                checker="donation",
+                code="not-donated",
+                severity="error",
+                message=(
+                    "no donated-state buffer is aliased in the compiled "
+                    "module — the jit call site lost donate_argnums, so "
+                    "params + optimizer state are double-buffered"
+                ),
+                detail=stats,
+            )
+        )
+    elif missing:
+        findings.append(
+            Finding(
+                checker="donation",
+                code="donation-rejected",
+                severity="warn",
+                message=(
+                    f"XLA rejected {len(missing)} of {len(expected)} "
+                    "donated-state aliases (those buffers are "
+                    "double-buffered); check for shape/dtype changes "
+                    "between the donated input and the outputs"
+                ),
+                detail={**stats, "missing_params": sorted(missing)[:16]},
+            )
+        )
+    return findings, stats
+
+
+def check_dtype(
+    summary: JaxprSummary,
+    compute_dtype: str,
+    *,
+    allowed_f32_dots: int = 0,
+) -> list[Finding]:
+    """Flag f32 matmuls and redundant convert chains in a bf16 program.
+
+    A dot whose output is f32 with bf16 inputs is FINE (MXU accumulation);
+    the leak is a dot whose inputs are already f32 when the program is
+    configured for bf16 compute — usually an upcast that snuck in ahead of
+    the matmul and silently halves matmul throughput.
+    """
+    findings: list[Finding] = []
+    if compute_dtype not in ("bfloat16", "float16"):
+        return findings
+    f32_dots = [
+        d
+        for d in summary.dots
+        if d.in_dtypes
+        and all(t == "float32" for t in d.in_dtypes)
+    ]
+    if len(f32_dots) > allowed_f32_dots:
+        in_loop = sum(1 for d in f32_dots if d.in_loop)
+        findings.append(
+            Finding(
+                checker="dtype",
+                code="f32-dot-leak",
+                severity="error",
+                message=(
+                    f"{len(f32_dots)} all-f32 matmul(s) in a "
+                    f"{compute_dtype} program ({in_loop} inside the hot "
+                    f"loop; {allowed_f32_dots} allowed) — an upcast ahead "
+                    "of the matmul is defeating the low-precision config"
+                ),
+                detail={
+                    "count": len(f32_dots),
+                    "allowed": allowed_f32_dots,
+                    "in_loop": in_loop,
+                },
+            )
+        )
+    chains = [c for c in summary.converts if c.chained]
+    hot_chains = [c for c in chains if c.in_loop]
+    if hot_chains:
+        findings.append(
+            Finding(
+                checker="dtype",
+                code="convert-chain",
+                severity="warn",
+                message=(
+                    f"{len(hot_chains)} back-to-back convert chain(s) on "
+                    "the hot path (e.g. bf16->f32->bf16): at least one "
+                    "conversion is wasted bandwidth"
+                ),
+                detail={
+                    "chains": [
+                        f"{c.in_dtype}->{c.out_dtype}" for c in hot_chains
+                    ][:16]
+                },
+            )
+        )
+    return findings
+
+
+def check_hazards(summary: JaxprSummary) -> list[Finding]:
+    """Host-sync and recompilation hazards visible in the jaxpr."""
+    findings: list[Finding] = []
+    for cb in summary.callbacks:
+        if cb.in_loop:
+            findings.append(
+                Finding(
+                    checker="hazards",
+                    code="callback-in-hot-loop",
+                    severity="error",
+                    message=(
+                        f"{cb.primitive} inside a scan/while body: every "
+                        "iteration round-trips to the host, serialising "
+                        f"the loop ({cb.detail or 'no detail'})"
+                    ),
+                    detail={"primitive": cb.primitive, "what": cb.detail},
+                )
+            )
+        else:
+            findings.append(
+                Finding(
+                    checker="hazards",
+                    code="host-callback",
+                    severity="warn",
+                    message=(
+                        f"{cb.primitive} in the traced program: fine for "
+                        "debugging, a host sync in production "
+                        f"({cb.detail or 'no detail'})"
+                    ),
+                    detail={"primitive": cb.primitive, "what": cb.detail},
+                )
+            )
+    if summary.weak_type_inputs:
+        findings.append(
+            Finding(
+                checker="hazards",
+                code="weak-typed-input",
+                severity="warn",
+                message=(
+                    f"{len(summary.weak_type_inputs)} argument(s) traced "
+                    "weak-typed (Python scalars): a later call with a "
+                    "different Python numeric type retraces AND "
+                    "recompiles; pass jnp arrays with explicit dtypes"
+                ),
+                detail={"avals": summary.weak_type_inputs[:8]},
+            )
+        )
+    return findings
+
+
+def audit_program(
+    fn,
+    args: tuple,
+    budget: CollectiveBudget | None = None,
+    *,
+    label: str | None = None,
+    donate_argnums: tuple[int, ...] = (0,),
+    expect_donation: bool = True,
+    compute_dtype: str | None = None,
+    allowed_f32_dots: int = 0,
+    checks: tuple[str, ...] = ALL_CHECKS,
+) -> AuditReport:
+    """Audit a jitted program's jaxpr + optimized HLO without running it.
+
+    ``fn``: a jitted callable (anything with ``.lower``; a plain function
+    is wrapped in a bare ``jax.jit``, in which case set
+    ``expect_donation=False`` since the wrapper donates nothing).
+    ``args``: example arguments, already placed/sharded the way the real
+    call site places them. ``budget``: the collective contract
+    (analysis/budget.expected_budget derives one from a MeshConfig);
+    None skips the budget diff but still records collective counts.
+    ``compute_dtype``: the activation dtype the program is configured for
+    (ModelConfig.dtype); dtype checks only engage for low-precision
+    programs.
+    """
+    unknown = set(checks) - set(ALL_CHECKS)
+    if unknown:
+        raise ValueError(f"unknown checks: {sorted(unknown)}")
+    # repolint: allow(jit-donation-decision) — inspection-only wrapper;
+    # donation is the audited call site's contract, and forcing it here
+    # would change the very alias accounting being audited.
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    hlo_text = compiled.as_text()
+
+    report = AuditReport(label=label or getattr(fn, "__name__", "program"))
+    report.summary["platform"] = jax.default_backend()
+
+    found = collective_instructions(hlo_text)
+    report.summary["collective_counts"] = {
+        op: len(names) for op, names in found.items()
+    }
+    if "collectives" in checks and budget is not None:
+        report.extend(check_budget(found, budget, classify=classify_op))
+        report.summary["budget"] = {
+            "required": sorted(budget.required),
+            "forbidden": sorted(budget.forbidden),
+            "max_counts": dict(budget.max_counts),
+            "note": budget.note,
+        }
+
+    if "donation" in checks and expect_donation:
+        try:
+            ma = compiled.memory_analysis()
+        except Exception:  # backend without the C API
+            ma = None
+        findings, stats = check_donation(
+            hlo_text, args, donate_argnums, memory_analysis=ma
+        )
+        report.extend(findings)
+        report.summary["donation"] = stats
+
+    if "dtype" in checks or "hazards" in checks:
+        summary = _program_summary(jitted, args)
+        if summary is None:
+            report.findings.append(
+                Finding(
+                    checker="hazards",
+                    code="jaxpr-unavailable",
+                    severity="info",
+                    message=(
+                        "could not trace a jaxpr for this program "
+                        "(static-argument signature the tracer cannot "
+                        "re-enter); dtype/hazard checks skipped"
+                    ),
+                )
+            )
+    else:
+        summary = None
+    if summary is not None:
+        report.summary["dot_dtypes"] = summary.dot_dtype_histogram()
+        report.summary["hazards"] = {
+            "callbacks": len(summary.callbacks),
+            "weak_type_inputs": len(summary.weak_type_inputs),
+            "chained_converts": sum(
+                1 for c in summary.converts if c.chained
+            ),
+        }
+        if "dtype" in checks and compute_dtype is not None:
+            report.extend(
+                check_dtype(
+                    summary,
+                    compute_dtype,
+                    allowed_f32_dots=allowed_f32_dots,
+                )
+            )
+        if "hazards" in checks:
+            report.extend(check_hazards(summary))
+
+    return report

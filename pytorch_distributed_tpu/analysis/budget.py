@@ -1,0 +1,205 @@
+"""Collective budgets: what a parallelism strategy is ALLOWED to emit.
+
+Generalises the hard-coded per-strategy assertions of
+tests/test_hlo_collectives.py into a reusable contract object:
+
+- ``required``: base opcodes that MUST appear (the collectives the
+  strategy's design promises — FSDP gathers+scatters, DDP all-reduces,
+  ring permutes, EP all-to-alls);
+- ``forbidden``: opcodes that must NOT appear (a sharding edit that sneaks
+  an all-gather into a DDP step is exactly the silent regression this
+  subsystem exists to catch);
+- ``max_counts``: optional per-opcode instruction-count ceilings for
+  programs whose collective count is part of the perf contract (e.g. ONE
+  gradient all-reduce at the accumulation boundary).
+
+``expected_budget`` derives the contract for a MeshConfig the same way the
+strategies themselves are written (parallel/explicit.py, parallel/pipeline.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from pytorch_distributed_tpu.analysis.hlo import HLO_COLLECTIVES
+from pytorch_distributed_tpu.analysis.report import Finding
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveBudget:
+    required: frozenset = frozenset()
+    forbidden: frozenset = frozenset()
+    max_counts: dict = dataclasses.field(default_factory=dict)
+    note: str = ""
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "required", frozenset(self.required))
+        object.__setattr__(self, "forbidden", frozenset(self.forbidden))
+        for op in self.required | self.forbidden | set(self.max_counts):
+            if op not in HLO_COLLECTIVES:
+                raise ValueError(
+                    f"unknown collective opcode {op!r}; known: "
+                    f"{HLO_COLLECTIVES}"
+                )
+        overlap = self.required & self.forbidden
+        if overlap:
+            raise ValueError(
+                f"opcodes both required and forbidden: {sorted(overlap)}"
+            )
+
+
+NO_COLLECTIVES = CollectiveBudget(
+    forbidden=frozenset(HLO_COLLECTIVES),
+    note="single-device program: any collective is a bug",
+)
+
+
+def expected_budget(
+    mesh_cfg: MeshConfig, model_cfg: ModelConfig | None = None
+) -> CollectiveBudget:
+    """The collective contract a (mesh, model) combination implies.
+
+    Mirrors the strategy implementations: required ops are the collectives
+    each active axis/strategy writes (or AD transposes into existence);
+    everything no active axis can legitimately produce is forbidden.
+    all-reduce is tolerated whenever ANY axis is active — every path
+    all-reduces the scalar loss/grad-norm metrics across its axes.
+    """
+    required: set[str] = set()
+    notes: list[str] = []
+
+    dp_active = mesh_cfg.data > 1
+    fsdp_active = mesh_cfg.fsdp > 1
+    if fsdp_active and mesh_cfg.strategy == "full_shard":
+        # ZeRO-3: just-in-time param all-gather; its AD transpose IS the
+        # gradient reduce-scatter.
+        required |= {"all-gather", "reduce-scatter"}
+        notes.append("fsdp/full_shard: gather params + scatter grads")
+    elif fsdp_active and mesh_cfg.strategy == "shard_grad_op":
+        # ZeRO-2: grads reduce-scattered onto opt-state shards; params
+        # re-materialise via a psum of disjoint slices (an all-reduce).
+        required |= {"reduce-scatter"}
+        notes.append("fsdp/shard_grad_op: scatter grads")
+    elif fsdp_active and mesh_cfg.strategy == "shard_opt":
+        # ZeRO-1: grads replicated-all-reduced like DDP.
+        required |= {"all-reduce"}
+        notes.append("fsdp/shard_opt: all-reduce grads")
+    elif fsdp_active:  # no_shard with an fsdp axis: pure data parallelism
+        required |= {"all-reduce"}
+    if dp_active:
+        required |= {"all-reduce"}
+        notes.append("data: all-reduce grads at the accumulation boundary")
+    if mesh_cfg.tensor > 1:
+        # Megatron f/g conjugates: psum after every row-parallel projection.
+        required |= {"all-reduce"}
+        notes.append("tensor: psum at parallel-region boundaries")
+    if mesh_cfg.seq > 1:
+        if model_cfg is not None and model_cfg.seq_impl == "ulysses":
+            required |= {"all-to-all"}
+            notes.append("seq/ulysses: head<->sequence all-to-all")
+        else:
+            required |= {"collective-permute"}
+            notes.append("seq/ring: KV ring ppermute")
+    if mesh_cfg.expert > 1:
+        required |= {"all-to-all"}
+        notes.append("expert: token dispatch all-to-all")
+    if mesh_cfg.pipe > 1:
+        required |= {"collective-permute"}
+        notes.append("pipe: stage-boundary shifts")
+
+    if not required:
+        return NO_COLLECTIVES
+
+    # Scalar metrics (loss, grad_norm) are all-reduced over every active
+    # axis on every path, so all-reduce can appear even when no strategy
+    # requires it for gradients.
+    tolerated = {"all-reduce"}
+    forbidden = set(HLO_COLLECTIVES) - required - tolerated
+    return CollectiveBudget(
+        required=frozenset(required),
+        forbidden=frozenset(forbidden),
+        note="; ".join(notes),
+    )
+
+
+def check_budget(
+    found: dict[str, list[str]],
+    budget: CollectiveBudget,
+    *,
+    classify=None,
+) -> list[Finding]:
+    """Diff the collectives a compiled program emits against its budget.
+
+    ``found``: {base_opcode: [instruction names]} from
+    analysis.hlo.collective_instructions. ``classify``: optional
+    name -> category function (profiling.trace_analysis.classify_op);
+    when given, every emitted collective instruction name must classify as
+    "communication" — the guarantee that trace analysis will account for
+    it (tests/test_hlo_collectives.py assertion 1).
+    """
+    findings: list[Finding] = []
+    present = set(found)
+
+    for op in sorted(budget.required - present):
+        findings.append(
+            Finding(
+                checker="collectives",
+                code="missing-collective",
+                severity="error",
+                message=(
+                    f"strategy promises {op!r} but the compiled program "
+                    f"never emits it (found: {sorted(present) or 'none'})"
+                ),
+                detail={"opcode": op, "found": sorted(present)},
+            )
+        )
+    for op in sorted(budget.forbidden & present):
+        findings.append(
+            Finding(
+                checker="collectives",
+                code="forbidden-collective",
+                severity="error",
+                message=(
+                    f"{op!r} appears {len(found[op])}x but the strategy "
+                    "has no business emitting it"
+                ),
+                detail={"opcode": op, "instructions": found[op]},
+            )
+        )
+    for op, cap in sorted(budget.max_counts.items()):
+        n = len(found.get(op, []))
+        if n > cap:
+            findings.append(
+                Finding(
+                    checker="collectives",
+                    code="budget-exceeded",
+                    severity="error",
+                    message=f"{op!r}: {n} instructions > budget of {cap}",
+                    detail={
+                        "opcode": op,
+                        "count": n,
+                        "budget": cap,
+                        "instructions": found.get(op, []),
+                    },
+                )
+            )
+    if classify is not None:
+        for op, names in sorted(found.items()):
+            for name in names:
+                cat = classify(name)
+                if cat != "communication":
+                    findings.append(
+                        Finding(
+                            checker="collectives",
+                            code="unclassified-collective",
+                            severity="error",
+                            message=(
+                                f"trace classifier labels {name!r} as "
+                                f"{cat!r}, not 'communication' — trace "
+                                "accounting would miscount this op"
+                            ),
+                            detail={"instruction": name, "category": cat},
+                        )
+                    )
+    return findings

@@ -1,0 +1,132 @@
+"""Optimized-HLO text parsing for the audit pass.
+
+XLA's compiled-module text is the ground truth for what a step actually
+does on device: the collective instructions it lists are exactly the op
+names a profiler trace row carries (pinned by tests/test_hlo_collectives.py),
+and the module header records the input/output buffer aliasing that
+donation (``donate_argnums``) negotiated with the compiler. This module
+extracts both without running the program.
+
+Scope note: dtype analysis does NOT live here. XLA:CPU legalises bf16
+dots into convert+f32-dot pairs during optimization, so optimized HLO on
+the CPU test rig misreports the program's numerics; dtype/convert checks
+run on the jaxpr instead (analysis/jaxpr_scan.py), which is
+platform-independent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+# Every HLO collective opcode (base form; XLA also emits async -start/-done
+# pairs whose instruction names contain the base).
+HLO_COLLECTIVES = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+    "collective-broadcast",
+    "ragged-all-to-all",
+)
+
+_INSTR_RE = re.compile(r"\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=")
+
+# Longest opcode first: \b matches after a hyphen, so "all-to-all" would
+# otherwise claim "ragged-all-to-all" instructions before the ragged
+# pattern gets a look.
+_COLLECTIVES_LONGEST_FIRST = sorted(HLO_COLLECTIVES, key=len, reverse=True)
+
+
+def collective_instructions(hlo_text: str) -> dict[str, list[str]]:
+    """{base_opcode: [instruction names]} for every collective instruction
+    in the compiled module text.
+
+    Instruction names (the left-hand side of each ``name = type op(...)``
+    line) are the strings that appear on profiler device tracks, so the
+    caller can cross-check them against trace classification
+    (profiling.trace_analysis.classify_op).
+    """
+    found: dict[str, list[str]] = {}
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        rhs = line[m.end():]
+        for op in _COLLECTIVES_LONGEST_FIRST:
+            if re.search(rf"\b{op}(?:-start|-done)?\(", rhs):
+                found.setdefault(op, []).append(m.group(1))
+                break
+    return found
+
+
+def collective_counts(hlo_text: str) -> dict[str, int]:
+    """{base_opcode: instruction count} (convenience over
+    collective_instructions)."""
+    return {
+        op: len(names)
+        for op, names in collective_instructions(hlo_text).items()
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class AliasEntry:
+    """One input->output buffer alias from the HLO module header."""
+
+    output_index: tuple[int, ...]
+    param_number: int
+    param_index: tuple[int, ...]
+    kind: str  # "may-alias" | "must-alias"
+
+
+# Header syntax: input_output_alias={ {0}: (0, {}, may-alias), {1}: (3, {1}) }
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{([\d,\s]*)\}"
+    r"(?:,\s*(may-alias|must-alias))?\)"
+)
+
+
+def _index_tuple(s: str) -> tuple[int, ...]:
+    return tuple(int(p) for p in s.split(",") if p.strip())
+
+
+def _alias_block(header: str) -> str | None:
+    """The balanced-brace body of ``input_output_alias={...}`` (the map
+    nests braces for output/param ShapeIndexes, so a regex can't scan it)."""
+    key = "input_output_alias={"
+    start = header.find(key)
+    if start < 0:
+        return None
+    depth, i = 1, start + len(key)
+    while i < len(header) and depth:
+        if header[i] == "{":
+            depth += 1
+        elif header[i] == "}":
+            depth -= 1
+        i += 1
+    return header[start + len(key): i - 1]
+
+
+def parse_input_output_aliases(hlo_text: str) -> list[AliasEntry]:
+    """Donated-buffer aliases the compiler ACCEPTED, from the HloModule
+    header. Empty list means no donation survived compilation (either the
+    jit had no donate_argnums or XLA rejected every alias)."""
+    header = hlo_text.splitlines()[0] if hlo_text else ""
+    block = _alias_block(header)
+    if block is None:
+        return []
+    return [
+        AliasEntry(
+            output_index=_index_tuple(e.group(1)),
+            param_number=int(e.group(2)),
+            param_index=_index_tuple(e.group(3)),
+            kind=e.group(4) or "may-alias",
+        )
+        for e in _ALIAS_ENTRY_RE.finditer(block)
+    ]
+
+
+def aliased_param_numbers(hlo_text: str) -> set[int]:
+    """Entry-parameter numbers with at least one accepted output alias."""
+    return {e.param_number for e in parse_input_output_aliases(hlo_text)}

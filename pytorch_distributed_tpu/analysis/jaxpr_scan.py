@@ -1,0 +1,174 @@
+"""Jaxpr-level program scan: dtypes, converts, callbacks, loop context.
+
+The jaxpr is the right level for numerics and host-interaction checks:
+
+- XLA:CPU legalises bf16 dots to f32 during HLO optimization, so the
+  compiled text on the CPU rig misreports matmul dtypes; the jaxpr records
+  what the program asked for on every platform.
+- ``debug_callback`` / ``io_callback`` / ``pure_callback`` equations are
+  explicit in the jaxpr but lower into infeed/outfeed plumbing that is hard
+  to attribute in HLO.
+- scan/while structure is still visible, so "inside the hot loop" is a
+  well-defined predicate (after jit, the training step's accumulation scan
+  and decode's sampling loop are the hot loops that matter).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Any
+
+import jax
+
+try:  # jax >= 0.4.16 moved the public core surface under jax.extend
+    from jax.extend.core import Literal  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.core import Literal  # type: ignore
+
+# Primitives whose bodies execute repeatedly at run time (hot loops).
+_LOOP_PRIMS = ("scan", "while", "fori_loop")
+# Host-callback primitives: each firing is a device->host sync point.
+_CALLBACK_PRIMS = ("debug_callback", "io_callback", "pure_callback")
+
+
+@dataclasses.dataclass(frozen=True)
+class DotRecord:
+    """One dot_general / conv_general_dilated equation."""
+
+    primitive: str
+    out_dtype: str
+    in_dtypes: tuple[str, ...]
+    preferred_element_type: str | None
+    in_loop: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvertRecord:
+    out_dtype: str
+    in_dtype: str
+    in_loop: bool
+    # The producing equation of this convert's operand is itself a convert
+    # (an A->B->A or A->B->C chain: at least one of the two is wasted work
+    # on the hot path).
+    chained: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class CallbackRecord:
+    primitive: str
+    in_loop: bool
+    # Best-effort description (debug.print format string / callback repr).
+    detail: str
+
+
+@dataclasses.dataclass
+class JaxprSummary:
+    dots: list[DotRecord]
+    converts: list[ConvertRecord]
+    callbacks: list[CallbackRecord]
+    # Input avals traced weak-typed: the caller passed Python scalars, so a
+    # later call with a different Python type retraces and recompiles.
+    weak_type_inputs: list[str]
+    primitive_counts: Counter
+
+    def dot_dtype_histogram(self) -> dict[str, int]:
+        hist: Counter = Counter(d.out_dtype for d in self.dots)
+        return dict(hist)
+
+
+def _subjaxprs(eqn) -> list[Any]:
+    subs = []
+    for key, val in eqn.params.items():
+        if hasattr(val, "jaxpr"):  # ClosedJaxpr
+            subs.append(val.jaxpr)
+        elif hasattr(val, "eqns"):  # bare Jaxpr
+            subs.append(val)
+        elif key == "branches":
+            subs.extend(b.jaxpr if hasattr(b, "jaxpr") else b for b in val)
+    return subs
+
+
+def _callback_detail(eqn) -> str:
+    for key in ("fmt", "callback", "debug_func"):
+        if key in eqn.params:
+            return repr(eqn.params[key])[:120]
+    return ""
+
+
+def scan_jaxpr(jaxpr) -> JaxprSummary:
+    """Walk a (closed or bare) jaxpr recursively into every sub-jaxpr
+    (pjit bodies, shard_map bodies, scan/while bodies, cond branches,
+    custom_vjp/jvp call jaxprs) and summarise the audit-relevant facts."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    summary = JaxprSummary(
+        dots=[],
+        converts=[],
+        callbacks=[],
+        weak_type_inputs=[],
+        primitive_counts=Counter(),
+    )
+    for var in jaxpr.invars:
+        aval = getattr(var, "aval", None)
+        if aval is not None and getattr(aval, "weak_type", False):
+            summary.weak_type_inputs.append(str(aval))
+
+    def walk(jx, in_loop: bool, convert_outvars: set):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            summary.primitive_counts[name] += 1
+            if name in ("dot_general", "conv_general_dilated"):
+                pet = eqn.params.get("preferred_element_type")
+                summary.dots.append(
+                    DotRecord(
+                        primitive=name,
+                        out_dtype=str(eqn.outvars[0].aval.dtype),
+                        in_dtypes=tuple(
+                            str(v.aval.dtype)
+                            for v in eqn.invars
+                            if hasattr(v, "aval")
+                        ),
+                        preferred_element_type=(
+                            str(pet) if pet is not None else None
+                        ),
+                        in_loop=in_loop,
+                    )
+                )
+            elif name == "convert_element_type":
+                src = eqn.invars[0]
+                summary.converts.append(
+                    ConvertRecord(
+                        out_dtype=str(eqn.outvars[0].aval.dtype),
+                        in_dtype=str(src.aval.dtype),
+                        in_loop=in_loop,
+                        chained=(
+                            not isinstance(src, Literal)
+                            and src in convert_outvars
+                        ),
+                    )
+                )
+                convert_outvars.add(eqn.outvars[0])
+            elif name in _CALLBACK_PRIMS:
+                summary.callbacks.append(
+                    CallbackRecord(
+                        primitive=name,
+                        in_loop=in_loop,
+                        detail=_callback_detail(eqn),
+                    )
+                )
+            loopish = any(name.startswith(p) for p in _LOOP_PRIMS)
+            for sub in _subjaxprs(eqn):
+                # Sub-jaxprs get a FRESH convert-producer scope: vars are
+                # jaxpr-local, so carrying the outer set across the
+                # boundary could only produce false identity matches.
+                walk(sub, in_loop or loopish, set())
+        return summary
+
+    return walk(jaxpr, False, set())
+
+
+def trace_summary(fn, args: tuple, kwargs: dict | None = None) -> JaxprSummary:
+    """Trace ``fn`` (jitted or plain) on ``args`` and scan the jaxpr."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **(kwargs or {}))
+    return scan_jaxpr(jaxpr)

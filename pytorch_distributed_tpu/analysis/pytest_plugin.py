@@ -1,0 +1,37 @@
+"""Pytest fixture for one-line program audits in parallelism tests.
+
+Usage (tests/conftest.py re-exports the fixture):
+
+    def test_my_strategy(audit):
+        step, args = build_step(...)
+        audit.assert_clean(step, args, expected_budget(mcfg, cfg))
+
+or, when the test wants the report itself:
+
+    report = audit(step, args, budget)
+    assert report.clean(), report.table()
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from pytorch_distributed_tpu.analysis.audit import audit_program
+from pytorch_distributed_tpu.analysis.report import AuditReport
+
+
+class ProgramAuditor:
+    """Callable wrapper over audit_program with an assertion helper."""
+
+    def __call__(self, fn, args, budget=None, **kwargs) -> AuditReport:
+        return audit_program(fn, args, budget, **kwargs)
+
+    def assert_clean(self, fn, args, budget=None, **kwargs) -> AuditReport:
+        report = audit_program(fn, args, budget, **kwargs)
+        assert report.clean(), "\n" + report.table()
+        return report
+
+
+@pytest.fixture
+def audit() -> ProgramAuditor:
+    return ProgramAuditor()

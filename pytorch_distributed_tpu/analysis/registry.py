@@ -1,0 +1,204 @@
+"""Registered (strategy x model) audit cases.
+
+One place that knows how to build every jitted training entry point on the
+virtual-device CPU rig (the same tiny-model constructions
+tests/test_hlo_collectives.py compiles), paired with the collective budget
+its strategy implies. Consumed by ``scripts/audit.py --all`` and by tests.
+
+Every case builds a REAL step function from the production builders
+(train/trainer.py, parallel/explicit.py, parallel/pipeline.py) — the audit
+runs against the exact programs training runs, not stand-ins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import numpy as np
+
+from pytorch_distributed_tpu.analysis.budget import (
+    NO_COLLECTIVES,
+    CollectiveBudget,
+    expected_budget,
+)
+from pytorch_distributed_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    TrainConfig,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditCase:
+    name: str
+    description: str
+    devices_needed: int
+    # () -> (fn, args, budget, audit_kwargs)
+    build: Callable[[], tuple]
+
+
+def _tiny(n_experts: int = 0, dtype: str = "float32") -> ModelConfig:
+    kw = dict(
+        vocab_size=128, n_ctx=16, n_embd=64, n_layer=2, n_head=4,
+        dtype=dtype, embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+    )
+    if n_experts:
+        kw.update(n_experts=n_experts, expert_capacity_factor=8.0)
+    return ModelConfig(**kw)
+
+
+def _tcfg(micro: int = 16) -> TrainConfig:
+    return TrainConfig(
+        global_batch_size=16, micro_batch_size=micro, num_steps=1,
+        learning_rate=1e-3,
+    )
+
+
+def _batch(rng_seed: int = 0, shape=(1, 16, 16)) -> dict:
+    rng = np.random.default_rng(rng_seed)
+    return {
+        "inputs": rng.integers(0, 128, shape).astype(np.int32),
+        "targets": rng.integers(0, 128, shape).astype(np.int32),
+    }
+
+
+def _build_baseline():
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.train.trainer import make_train_step
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _tiny()
+    model = get_model(cfg)
+    tx = make_optimizer(_tcfg())
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    step = make_train_step(model, cfg, tx)
+    args = (state, _batch(), jax.random.key(0))
+    return step, args, NO_COLLECTIVES, {"compute_dtype": cfg.dtype}
+
+
+def _build_explicit(mcfg: MeshConfig, n_experts: int = 0):
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh, shard_train_state
+    from pytorch_distributed_tpu.parallel.explicit import (
+        make_explicit_train_step,
+    )
+    from pytorch_distributed_tpu.parallel.mesh import make_batch_put
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _tiny(n_experts)
+    model = get_model(cfg)
+    tx = make_optimizer(_tcfg())
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
+    batch = make_batch_put(mesh, mcfg)(_batch())
+    args = (state, batch, jax.random.key(0))
+    return step, args, expected_budget(mcfg, cfg), {
+        "compute_dtype": cfg.dtype
+    }
+
+
+def _build_pipeline(schedule: str):
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.pipeline import (
+        make_pipeline_train_step,
+        shard_pipeline_state,
+    )
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _tiny()
+    tcfg = _tcfg(micro=4)
+    model = get_model(cfg)
+    tx = make_optimizer(tcfg)
+    mcfg = MeshConfig(
+        pipe=2, strategy="no_shard", pipe_schedule=schedule
+    )
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    state, _ = shard_pipeline_state(state, mesh, mcfg)
+    step = make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state, tcfg)
+    args = (state, _batch(shape=(4, 4, 16)), jax.random.key(0))
+    return step, args, expected_budget(mcfg, cfg), {
+        "compute_dtype": cfg.dtype
+    }
+
+
+def registered_cases() -> dict[str, AuditCase]:
+    """name -> AuditCase for every audited (strategy x model) combo."""
+    cases = [
+        AuditCase(
+            "baseline",
+            "single-device jit train step (no mesh, no collectives)",
+            1,
+            _build_baseline,
+        ),
+        AuditCase(
+            "ddp",
+            "explicit DDP: data=8, no_shard",
+            8,
+            lambda: _build_explicit(
+                MeshConfig(data=8, strategy="no_shard")
+            ),
+        ),
+        AuditCase(
+            "fsdp",
+            "explicit ZeRO-3: fsdp=8, full_shard",
+            8,
+            lambda: _build_explicit(
+                MeshConfig(fsdp=8, strategy="full_shard")
+            ),
+        ),
+        AuditCase(
+            "zero2",
+            "explicit ZeRO-2: fsdp=8, shard_grad_op",
+            8,
+            lambda: _build_explicit(
+                MeshConfig(fsdp=8, strategy="shard_grad_op")
+            ),
+        ),
+        AuditCase(
+            "tp",
+            "explicit tensor parallelism: tensor=4",
+            4,
+            lambda: _build_explicit(
+                MeshConfig(tensor=4, strategy="no_shard")
+            ),
+        ),
+        AuditCase(
+            "ring",
+            "ring-attention context parallelism: seq=4",
+            4,
+            lambda: _build_explicit(
+                MeshConfig(seq=4, strategy="no_shard")
+            ),
+        ),
+        AuditCase(
+            "ep",
+            "expert parallelism: expert=4, 4-expert MoE",
+            4,
+            lambda: _build_explicit(
+                MeshConfig(expert=4, strategy="no_shard"), n_experts=4
+            ),
+        ),
+        AuditCase(
+            "pipeline",
+            "GPipe pipeline: pipe=2",
+            2,
+            _build_pipeline_gpipe,
+        ),
+    ]
+    return {c.name: c for c in cases}
+
+
+def _build_pipeline_gpipe():
+    return _build_pipeline("gpipe")

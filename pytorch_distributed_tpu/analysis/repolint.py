@@ -1,0 +1,284 @@
+"""Repo-rule AST lint: project invariants a reviewer should never have to
+re-litigate.
+
+Rules (each suppressible per line with ``# repolint: allow(<rule>) — why``
+on the offending line or the line above; the reason is REQUIRED — a bare
+allow is itself a violation):
+
+- ``jit-donation-decision`` — every ``jax.jit`` call site / decorator
+  must either pass ``donate_argnums``/``donate_argnames`` or carry an
+  allow-comment explaining why its inputs must survive. Losing donation on
+  a step function silently double-buffers params + optimizer state; the
+  decision must be explicit either way.
+- ``host-sync-in-traced`` — no ``jax.device_get`` / ``np.asarray`` /
+  ``np.array`` inside a traced (jitted) function body: at best a
+  trace-time constant bake, at worst a per-call device sync.
+- ``wallclock-in-traced`` — no ``time.time``/``time.perf_counter``/
+  ``datetime.now`` inside traced code: it executes ONCE at trace time and
+  the program forever reports that frozen instant.
+- ``debug-callback-in-library`` — ``jax.debug.print`` / ``io_callback`` /
+  ``jax.debug.callback`` in library code (``pytorch_distributed_tpu/``)
+  must be allowlisted: each firing is a host round-trip
+  (scripts/ and tests/ may debug freely).
+
+Run: ``python -m pytorch_distributed_tpu.analysis.repolint [paths...]``
+(default: the package + scripts/). Exit code 1 on any violation — wired
+into CI next to the tier-1 tests.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+import sys
+from pathlib import Path
+
+_ALLOW_RE = re.compile(r"#\s*repolint:\s*allow\(([\w\-]+)\)\s*(?:—|--|-)\s*\S")
+_BARE_ALLOW_RE = re.compile(r"#\s*repolint:\s*allow\(([\w\-]+)\)")
+
+RULES = (
+    "jit-donation-decision",
+    "host-sync-in-traced",
+    "wallclock-in-traced",
+    "debug-callback-in-library",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute chains, 'jit' for Names, '' otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _jit_call_sites(tree: ast.AST) -> list[ast.Call]:
+    """Every ``jax.jit(...)`` Call, including inside ``partial(jax.jit, ...)``."""
+    sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            if _is_jit_callable(node.func):
+                sites.append(node)
+            elif _dotted(node.func) in ("functools.partial", "partial"):
+                if node.args and _is_jit_callable(node.args[0]):
+                    sites.append(node)
+    return sites
+
+
+def _jit_argument_names(tree: ast.AST) -> set[str]:
+    """Names of functions passed (positionally) to a jax.jit call in this
+    module — their bodies are traced."""
+    names = set()
+    for call in _jit_call_sites(tree):
+        args = call.args
+        if _dotted(call.func) in ("functools.partial", "partial"):
+            args = call.args[1:]
+        for a in args[:1]:
+            if isinstance(a, ast.Name):
+                names.add(a.id)
+    return names
+
+
+def _traced_functions(tree: ast.AST) -> list[ast.FunctionDef]:
+    """FunctionDefs whose bodies trace under jit: decorated with jax.jit
+    (bare or via partial) or passed by name to a jax.jit call site."""
+    jitted_names = _jit_argument_names(tree)
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            if _is_jit_callable(dec):
+                out.append(node)
+                break
+            if isinstance(dec, ast.Call) and (
+                _is_jit_callable(dec.func)
+                or (
+                    _dotted(dec.func) in ("functools.partial", "partial")
+                    and dec.args
+                    and _is_jit_callable(dec.args[0])
+                )
+            ):
+                out.append(node)
+                break
+        else:
+            if node.name in jitted_names:
+                out.append(node)
+    return out
+
+
+def _allowed(lines: list[str], lineno: int, rule: str) -> bool:
+    """allow-comment (with a reason) on the line itself or in the
+    contiguous comment block immediately above it."""
+    if 1 <= lineno <= len(lines):
+        m = _ALLOW_RE.search(lines[lineno - 1])
+        if m and m.group(1) == rule:
+            return True
+    ln = lineno - 1
+    while 1 <= ln <= len(lines) and lines[ln - 1].lstrip().startswith("#"):
+        m = _ALLOW_RE.search(lines[ln - 1])
+        if m and m.group(1) == rule:
+            return True
+        ln -= 1
+    return False
+
+
+def _bare_allows(lines: list[str]) -> list[tuple[int, str]]:
+    """allow-comments with no reason text (themselves violations)."""
+    out = []
+    for i, line in enumerate(lines, 1):
+        m = _BARE_ALLOW_RE.search(line)
+        if m and not _ALLOW_RE.search(line):
+            out.append((i, m.group(1)))
+    return out
+
+
+_HOST_SYNC_CALLS = ("jax.device_get", "np.asarray", "np.array",
+                    "numpy.asarray", "numpy.array")
+_WALLCLOCK_CALLS = ("time.time", "time.perf_counter", "time.monotonic",
+                    "datetime.now", "datetime.datetime.now")
+_DEBUG_CALLS = ("jax.debug.print", "jax.debug.callback", "io_callback",
+                "jax.experimental.io_callback")
+
+
+def lint_source(
+    source: str, path: str, *, library: bool = False
+) -> list[Violation]:
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:  # pragma: no cover - repo code parses
+        return [Violation("parse-error", path, e.lineno or 0, str(e))]
+
+    violations: list[Violation] = []
+
+    def add(rule: str, lineno: int, message: str) -> None:
+        if not _allowed(lines, lineno, rule):
+            violations.append(Violation(rule, path, lineno, message))
+
+    for lineno, rule in _bare_allows(lines):
+        violations.append(
+            Violation(
+                rule, path, lineno,
+                "allow-comment without a reason — write "
+                "'# repolint: allow(rule) — why'",
+            )
+        )
+
+    # Rule: jit-donation-decision
+    for call in _jit_call_sites(tree):
+        kwargs = {kw.arg for kw in call.keywords}
+        if not kwargs & {"donate_argnums", "donate_argnames"}:
+            add(
+                "jit-donation-decision",
+                call.lineno,
+                "jax.jit without donate_argnums — donate the step state, "
+                "or allowlist with the reason its inputs must survive",
+            )
+    # Bare `@jax.jit` decorators are not Call nodes and can never pass
+    # donate_argnums, so they need an allow-comment just the same.
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call) and _is_jit_callable(dec):
+                    add(
+                        "jit-donation-decision",
+                        dec.lineno,
+                        f"bare @jax.jit on {node.name!r} cannot pass "
+                        "donate_argnums — use jax.jit(...) with a "
+                        "donation decision, or allowlist with the reason",
+                    )
+
+    # Rules inside traced bodies.
+    for fn in _traced_functions(tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            if name in _HOST_SYNC_CALLS:
+                add(
+                    "host-sync-in-traced",
+                    node.lineno,
+                    f"{name}() inside traced function {fn.name!r}: this "
+                    "bakes a trace-time constant / forces a host sync",
+                )
+            elif name in _WALLCLOCK_CALLS:
+                add(
+                    "wallclock-in-traced",
+                    node.lineno,
+                    f"{name}() inside traced function {fn.name!r}: "
+                    "evaluates once at trace time, frozen thereafter",
+                )
+
+    # Rule: debug callbacks in library code (anywhere in the module, traced
+    # or not — library modules should not ship debug prints).
+    if library:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = _dotted(node.func)
+                if name in _DEBUG_CALLS:
+                    add(
+                        "debug-callback-in-library",
+                        node.lineno,
+                        f"{name}() in library code: a host round-trip per "
+                        "firing — gate it or move it to scripts/",
+                    )
+    return violations
+
+
+def lint_paths(paths: list[Path], repo_root: Path) -> list[Violation]:
+    violations: list[Violation] = []
+    for base in paths:
+        files = [base] if base.is_file() else sorted(base.rglob("*.py"))
+        for f in files:
+            rel = f.relative_to(repo_root) if f.is_relative_to(repo_root) else f
+            library = str(rel).startswith("pytorch_distributed_tpu")
+            violations.extend(
+                lint_source(f.read_text(), str(rel), library=library)
+            )
+    return violations
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    repo_root = Path(__file__).resolve().parents[2]
+    if argv:
+        paths = [Path(p).resolve() for p in argv]
+    else:
+        paths = [
+            repo_root / "pytorch_distributed_tpu",
+            repo_root / "scripts",
+        ]
+    violations = lint_paths(paths, repo_root)
+    for v in violations:
+        print(v)
+    n = len(violations)
+    print(
+        f"repolint: {n} violation(s)" if n else "repolint: clean",
+        file=sys.stderr,
+    )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,113 @@
+"""Audit findings and the report container shared by every checker."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+SEVERITIES = ("error", "warn", "info")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One audit observation.
+
+    checker: which pass produced it ("collectives", "donation", "dtype",
+             "hazards").
+    code:    stable machine-readable identifier (e.g. "missing-collective").
+    severity: "error" (the program violates its contract), "warn"
+             (suspicious, judgement call), "info" (context for the reader).
+    """
+
+    checker: str
+    code: str
+    severity: str
+    message: str
+    detail: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+
+
+@dataclasses.dataclass
+class AuditReport:
+    """Outcome of auditing one jitted program."""
+
+    label: str
+    findings: list[Finding] = dataclasses.field(default_factory=list)
+    # Checker-populated context (collective counts, donation stats, dot
+    # dtype histogram, ...) for JSON output and tables.
+    summary: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == "warn"]
+
+    def clean(self, *, allow_warnings: bool = True) -> bool:
+        """True when the program passed: no errors (and, with
+        allow_warnings=False, no warnings either)."""
+        if self.errors:
+            return False
+        return allow_warnings or not self.warnings
+
+    def extend(self, findings: list[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "label": self.label,
+            "clean": self.clean(),
+            "findings": [dataclasses.asdict(f) for f in self.findings],
+            "summary": self.summary,
+        }
+
+    def table(self) -> str:
+        """Human-readable report block."""
+        status = "PASS" if self.clean() else "FAIL"
+        lines = [f"=== audit: {self.label} [{status}] ==="]
+        cc = self.summary.get("collective_counts")
+        if cc is not None:
+            pretty = (
+                ", ".join(f"{k}x{v}" for k, v in sorted(cc.items()))
+                or "(none)"
+            )
+            lines.append(f"  collectives: {pretty}")
+        don = self.summary.get("donation")
+        if don:
+            lines.append(
+                "  donation:    {aliased}/{expected} state buffers aliased"
+                .format(**don)
+            )
+        dots = self.summary.get("dot_dtypes")
+        if dots:
+            pretty = ", ".join(f"{k}x{v}" for k, v in sorted(dots.items()))
+            lines.append(f"  dot dtypes:  {pretty}")
+        haz = self.summary.get("hazards")
+        if haz is not None:
+            lines.append(
+                f"  hazards:     {haz.get('callbacks', 0)} callback(s), "
+                f"{haz.get('weak_type_inputs', 0)} weak-typed input(s), "
+                f"{haz.get('chained_converts', 0)} chained convert(s)"
+            )
+        for f in self.findings:
+            if f.severity == "info":
+                continue
+            lines.append(f"  [{f.severity.upper():5s}] {f.code}: {f.message}")
+        return "\n".join(lines)
+
+
+def reports_to_json(reports: list[AuditReport]) -> str:
+    return json.dumps(
+        {
+            "clean": all(r.clean() for r in reports),
+            "reports": [r.to_json() for r in reports],
+        },
+        indent=2,
+        sort_keys=True,
+    )

@@ -285,6 +285,8 @@ def _generate_impl(
     return out
 
 
+# repolint: allow(jit-donation-decision) — params are the serving
+# weights, reused by every generate call; the cache is jit-internal.
 @partial(
     jax.jit,
     static_argnames=(
@@ -483,6 +485,8 @@ def _fsdp_generate_compiled(
             max_len, top_k, top_p,
         )
 
+    # repolint: allow(jit-donation-decision) — sharded serving weights
+    # are reused across generate_fsdp calls; nothing here is consumed.
     fn = jax.jit(
         body,
         in_shardings=(shardings, replicated, replicated),
@@ -502,10 +506,7 @@ def _tp_generate_compiled(
     from the abstract init so the cache needs no concrete params."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from pytorch_distributed_tpu.utils.compat import shard_map
 
     mesh, p_specs, shardings = _mesh_param_shardings(cfg, mesh_cfg)
 
@@ -523,4 +524,6 @@ def _tp_generate_compiled(
         out_specs=P(),
         check_vma=True,
     )
+    # repolint: allow(jit-donation-decision) — TP serving weights are
+    # reused across generate_tp calls; the KV cache is jit-internal.
     return jax.jit(smapped), shardings

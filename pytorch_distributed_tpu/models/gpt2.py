@@ -48,6 +48,7 @@ from pytorch_distributed_tpu.ops.attention import multi_head_attention
 from pytorch_distributed_tpu.ops.layers import activation, dense, dropout, layer_norm
 from pytorch_distributed_tpu.ops.remat import apply_remat, checkpoint_name
 from pytorch_distributed_tpu.ops.tp import tp_copy
+from pytorch_distributed_tpu.utils.compat import vma_of
 
 Params = dict[str, Any]
 
@@ -328,7 +329,7 @@ def apply(
 
     aux0 = pvary_missing(
         jnp.zeros((), jnp.float32),
-        tuple(getattr(jax.typeof(x), "vma", frozenset())),
+        tuple(vma_of(x)),
     )
     (x, aux_total), _ = jax.lax.scan(
         body, (x, aux0), (params["blocks"], layer_ids),
@@ -430,7 +431,7 @@ def run_blocks(
 
     aux0 = pvary_missing(
         jnp.zeros((), jnp.float32),
-        tuple(getattr(jax.typeof(x), "vma", frozenset())),
+        tuple(vma_of(x)),
     )
     n_local = jax.tree.leaves(blocks)[0].shape[0]
     (x, aux_total), _ = jax.lax.scan(

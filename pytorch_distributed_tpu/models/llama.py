@@ -33,6 +33,7 @@ from pytorch_distributed_tpu.ops.layers import rms_norm
 from pytorch_distributed_tpu.ops.remat import apply_remat, checkpoint_name
 from pytorch_distributed_tpu.ops.rope import apply_rope, rope_angles
 from pytorch_distributed_tpu.ops.tp import tp_copy, tp_reduce
+from pytorch_distributed_tpu.utils.compat import vma_of
 
 Params = dict[str, Any]
 
@@ -201,7 +202,7 @@ def apply(
 
     aux0 = pvary_missing(
         jnp.zeros((), jnp.float32),
-        tuple(getattr(jax.typeof(x), "vma", frozenset())),
+        tuple(vma_of(x)),
     )
     (x, aux_total), _ = jax.lax.scan(
         body, (x, aux0), params["blocks"], unroll=cfg.scan_unroll
@@ -274,7 +275,7 @@ def run_blocks(
 
     aux0 = pvary_missing(
         jnp.zeros((), jnp.float32),
-        tuple(getattr(jax.typeof(x), "vma", frozenset())),
+        tuple(vma_of(x)),
     )
     (x, aux_total), _ = jax.lax.scan(
         apply_remat(body, cfg.remat), (x, aux0), blocks

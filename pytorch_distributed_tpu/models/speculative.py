@@ -89,6 +89,8 @@ def _lookup_draft(out_buf, pos, *, ngram: int, draft_len: int, total: int):
     return jnp.where(known, draft, 0).astype(jnp.int32)
 
 
+# repolint: allow(jit-donation-decision) — params are the serving
+# weights, reused by every speculative-decode call.
 @partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "draft_len", "ngram",

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_distributed_tpu.ops.attention import NEG_INF, _repeat_kv
+from pytorch_distributed_tpu.utils.compat import vma_of
 
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
@@ -153,6 +154,8 @@ def _pallas_flash(q, k, v, *, causal: bool) -> jax.Array:
     return out.transpose(0, 2, 1, 3)
 
 
+# repolint: allow(jit-donation-decision) — functional attention op:
+# q/k/v belong to the caller and are read again in the backward pass.
 @functools.partial(
     jax.jit, static_argnames=("causal", "block_q", "block_k")
 )
@@ -226,7 +229,7 @@ def blockwise_attention(
         # carry must vary on the same mesh axes as the activations.
         from pytorch_distributed_tpu.ops.tp import pvary_missing
 
-        vma = tuple(getattr(jax.typeof(q_blk), "vma", frozenset()))
+        vma = tuple(vma_of(q_blk))
         acc0 = pvary_missing(
             jnp.zeros((b, h, block_q, d), jnp.float32), vma
         )

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_distributed_tpu.ops.attention import NEG_INF, _repeat_kv
+from pytorch_distributed_tpu.utils.compat import vma_of
 
 
 def ring_attention(
@@ -119,7 +120,7 @@ def ring_attention(
     # batch dim is typically sharded over data/fsdp axes too), or the
     # lax.cond/scan branches disagree on types.
     target_vma = frozenset().union(
-        *(getattr(jax.typeof(a), "vma", frozenset()) for a in (q, k, v))
+        *(vma_of(a) for a in (q, k, v))
     ) | {axis_name}
 
     def varying(x):

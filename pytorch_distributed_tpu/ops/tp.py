@@ -28,6 +28,8 @@ import functools
 
 import jax
 
+from pytorch_distributed_tpu.utils.compat import pcast_varying, vma_of
+
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
 def _tp_copy(x, axis):
@@ -36,7 +38,7 @@ def _tp_copy(x, axis):
     # the hand-written one in the backward rule below. (If the output stayed
     # typed invariant, vma-aware AD would insert its own psum when
     # transposing the first sharded-matmul use — double-counting with ours.)
-    return jax.lax.pcast(x, (axis,), to="varying")
+    return pcast_varying(x, (axis,))
 
 
 def _tp_copy_fwd(x, axis):
@@ -76,9 +78,9 @@ def pvary_missing(x: jax.Array, axes) -> jax.Array:
     varying on (pcast rejects axes that are already varying). The shared
     helper for initialising shard_map scan/cond accumulators under
     check_vma typing."""
-    have = getattr(getattr(x, "aval", None), "vma", frozenset())
+    have = vma_of(x)
     need = tuple(ax for ax in axes if ax not in have)
-    return jax.lax.pcast(x, need, to="varying") if need else x
+    return pcast_varying(x, need)
 
 
 def tp_reduce(x: jax.Array, axis: str | None) -> jax.Array:

@@ -60,10 +60,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # stable location since jax 0.6
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from pytorch_distributed_tpu.utils.compat import shard_map
 
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 from pytorch_distributed_tpu.models import ModelApi

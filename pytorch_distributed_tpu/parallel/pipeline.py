@@ -70,10 +70,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+from pytorch_distributed_tpu.utils.compat import shard_map, vma_of
 
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from pytorch_distributed_tpu.models import ModelApi
@@ -534,7 +531,7 @@ def make_pipeline_train_step(
         zero_grads = jax.tree.map(
             lambda p: pvary_missing(
                 jnp.zeros(p.shape, jnp.float32),
-                tuple(getattr(jax.typeof(p), "vma", frozenset())),
+                tuple(vma_of(p)),
             ),
             vparams,
         )

@@ -155,6 +155,9 @@ def compiled_memory_analysis(fn, *example_args) -> dict | None:
     try:
         # Already-jitted callables lower directly (preserving donation /
         # aliasing); plain functions get wrapped.
+        # repolint: allow(jit-donation-decision) — wraps the USER's fn
+        # purely to lower it; adding donation would skew the
+        # alias/argument byte accounting this function reports.
         jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
         compiled = jitted.lower(*example_args).compile()
         ma = compiled.memory_analysis()

@@ -89,13 +89,20 @@ def _op_threads(trace: dict, pids: set[int]) -> set[tuple[int, int]]:
 # instruction names the TPU path emits (all_gather.N, reduce_scatter.N,
 # fusion.N, ...), so classify_op's HLO-name pinning
 # (tests/test_hlo_collectives.py) applies unchanged.
-_CPU_RUNTIME_THREADS = ("tf_XLAEigen", "tf_XLAPjRtCpuClient")
+_CPU_RUNTIME_THREADS = (
+    "tf_XLAEigen",
+    "tf_XLAPjRtCpuClient",
+    # Older PJRT CPU runtime (jax 0.4.x) names its thunk threadpool after
+    # the TFRT client instead.
+    "tf_XLATfrtCpuClient",
+)
 # Runtime bookkeeping rows interleaved with the op rows on those threads:
 # "end: <op>" cleanup markers (would double-count the op name) and the
 # thunk-executor / threadpool / transpose-plan internals that NEST around
 # real ops.
 _CPU_INFRA_PREFIXES = (
     "end: ", "ThunkExecutor", "ThreadpoolListener", "Transpose",
+    "TfrtCpuExecutable",
 )
 
 

@@ -211,6 +211,8 @@ def make_eval_step(
         logits = model.apply(params, inputs, model_cfg)
         return cross_entropy_loss(logits, targets)
 
+    # repolint: allow(jit-donation-decision) — eval reads params the
+    # training loop still owns; donating them would free live state.
     return jax.jit(eval_fn) if jit else eval_fn
 
 

@@ -1,0 +1,122 @@
+"""Audit every registered (strategy x model) training program statically.
+
+Compiles each registered case on virtual CPU devices and runs the full
+audit pass (collective budget, donation, dtype leaks, hazards) WITHOUT
+executing a step — the pre-flight check that a sharding/optimizer edit
+didn't sneak in an extra all-gather, drop donation, or upcast the hot
+matmuls. See docs/ANALYSIS.md.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/audit.py --all
+    python scripts/audit.py --case fsdp --case zero2 --json report.json
+
+Exit code: 0 when every audited program is clean, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import _common  # noqa: F401  (sys.path bootstrap)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--all", action="store_true",
+                   help="audit every registered case")
+    p.add_argument("--case", action="append", default=[],
+                   help="audit one named case (repeatable); see --list")
+    p.add_argument("--list", action="store_true",
+                   help="list registered cases and exit")
+    p.add_argument("--json", default=None,
+                   help="write the machine-readable report here")
+    p.add_argument("--cpu-devices", type=int, default=8,
+                   help="virtual CPU device count (mesh cases need 8)")
+    p.add_argument("--strict", action="store_true",
+                   help="warnings also fail the audit")
+    p.add_argument("--allow-skips", action="store_true",
+                   help="don't fail when a case is skipped for lack of "
+                        "devices (default: a skipped audit is a failed "
+                        "audit, so CI can't silently audit nothing)")
+    args = p.parse_args()
+
+    # Platform setup MUST precede any jax import (same contract as the
+    # other entry scripts / tests/conftest.py).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{args.cpu_devices}"
+        ).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    if os.environ["JAX_PLATFORMS"] == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from pytorch_distributed_tpu.analysis import (
+        audit_program,
+        reports_to_json,
+    )
+    from pytorch_distributed_tpu.analysis.registry import registered_cases
+
+    cases = registered_cases()
+    if args.list:
+        for name, case in cases.items():
+            print(f"{name:10s} {case.description}")
+        return 0
+    names = list(cases) if args.all or not args.case else args.case
+    unknown = [n for n in names if n not in cases]
+    if unknown:
+        p.error(f"unknown case(s): {unknown}; known: {list(cases)}")
+
+    n_dev = len(jax.devices())
+    reports = []
+    failed = False
+    skipped = []
+    for name in names:
+        case = cases[name]
+        if case.devices_needed > n_dev:
+            print(
+                f"=== audit: {name} [SKIP] needs {case.devices_needed} "
+                f"devices, have {n_dev} ==="
+            )
+            skipped.append(name)
+            continue
+        fn, fn_args, budget, kwargs = case.build()
+        report = audit_program(fn, fn_args, budget, label=name, **kwargs)
+        reports.append(report)
+        print(report.table())
+        if not report.clean(allow_warnings=not args.strict):
+            failed = True
+
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(reports_to_json(reports))
+        print(f"wrote {args.json}")
+
+    # Summary strictness matches the exit code's, so "N clean" and the
+    # exit status can never disagree.
+    n_bad = sum(
+        1 for r in reports
+        if not r.clean(allow_warnings=not args.strict)
+    )
+    print(
+        f"\naudited {len(reports)} program(s): "
+        f"{len(reports) - n_bad} clean, {n_bad} failing, "
+        f"{len(skipped)} skipped"
+    )
+    if skipped and not args.allow_skips:
+        print(
+            f"FAIL: skipped case(s) {skipped} — an unaudited program is "
+            "an unverified program (pass --allow-skips to tolerate, or "
+            "raise --cpu-devices)"
+        )
+        failed = True
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

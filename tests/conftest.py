@@ -27,6 +27,9 @@ import pytest  # noqa: E402
 # failures would lose their operand values.
 pytest.register_assert_rewrite("_pipeline_common")
 
+from pytorch_distributed_tpu.analysis.pytest_plugin import (  # noqa: E402,F401
+    audit,
+)
 from pytorch_distributed_tpu.config import ModelConfig  # noqa: E402
 
 

@@ -1,0 +1,406 @@
+"""Static-analysis subsystem tests (analysis/).
+
+Covers the parsers (HLO text, jaxpr scan), the budget/donation/dtype/
+hazard checkers against DELIBERATELY BROKEN fixtures (an injected
+all-gather, a jit that dropped donate_argnums, an f32 upcast in a bf16
+program, a debug.print in the hot loop), the repo lint rules, and the
+pytest fixture — the subsystem must catch each planted defect, and pass
+the clean twins.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_tpu.analysis import (
+    NO_COLLECTIVES,
+    CollectiveBudget,
+    audit_program,
+    check_budget,
+    collective_instructions,
+    expected_budget,
+    parse_input_output_aliases,
+)
+from pytorch_distributed_tpu.analysis.jaxpr_scan import trace_summary
+from pytorch_distributed_tpu.analysis.repolint import lint_source
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
+from pytorch_distributed_tpu.profiling.trace_analysis import classify_op
+from pytorch_distributed_tpu.utils.compat import shard_map
+
+
+# ---------------------------------------------------------------- parsers
+
+_HLO_SAMPLE = """\
+HloModule jit_step, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, may-alias) }, entry_computation_layout={(f32[8]{0})->f32[8]{0}}
+ENTRY main {
+  %p0 = f32[8]{0} parameter(0)
+  %all-gather.7 = f32[64]{0} all-gather(f32[8]{0} %p0), dimensions={0}
+  %all-reduce-start.2 = f32[8]{0} all-reduce-start(f32[8]{0} %p0)
+  ROOT %reduce-scatter.1 = f32[1]{0} reduce-scatter(f32[8]{0} %p0)
+}
+"""
+
+
+def test_collective_instructions_parses_ops_and_names():
+    found = collective_instructions(_HLO_SAMPLE)
+    assert set(found) == {"all-gather", "all-reduce", "reduce-scatter"}
+    assert found["all-gather"] == ["all-gather.7"]
+    assert found["all-reduce"] == ["all-reduce-start.2"]
+    assert found["reduce-scatter"] == ["reduce-scatter.1"]
+
+
+def test_ragged_all_to_all_not_claimed_by_all_to_all():
+    """\\b matches after a hyphen, so opcode matching must go longest
+    first or 'all-to-all' swallows every ragged-all-to-all instruction."""
+    hlo = (
+        "HloModule m\n"
+        "  %ragged-all-to-all.1 = f32[8]{0} ragged-all-to-all(%p0)\n"
+        "  %all-to-all.2 = f32[8]{0} all-to-all(%p0)\n"
+    )
+    found = collective_instructions(hlo)
+    assert found == {
+        "ragged-all-to-all": ["ragged-all-to-all.1"],
+        "all-to-all": ["all-to-all.2"],
+    }
+
+
+def test_alias_parsing_handles_nested_braces():
+    entries = parse_input_output_aliases(_HLO_SAMPLE)
+    assert [(e.output_index, e.param_number) for e in entries] == [
+        ((0,), 0),
+        ((1,), 2),
+    ]
+    assert parse_input_output_aliases("HloModule foo\n") == []
+
+
+# ---------------------------------------------------------------- budgets
+
+def test_expected_budget_matrix():
+    assert expected_budget(MeshConfig()) is NO_COLLECTIVES
+    ddp = expected_budget(MeshConfig(data=8, strategy="no_shard"))
+    assert ddp.required == {"all-reduce"}
+    fsdp = expected_budget(MeshConfig(fsdp=8, strategy="full_shard"))
+    assert fsdp.required == {"all-gather", "reduce-scatter"}
+    z2 = expected_budget(MeshConfig(fsdp=8, strategy="shard_grad_op"))
+    assert z2.required == {"reduce-scatter"}
+    assert "all-gather" in z2.forbidden
+    tp = expected_budget(MeshConfig(tensor=4, strategy="no_shard"))
+    assert tp.required == {"all-reduce"}
+    ring = expected_budget(MeshConfig(seq=4, strategy="no_shard"))
+    assert ring.required == {"collective-permute"}
+    ulysses = expected_budget(
+        MeshConfig(seq=4, strategy="no_shard"),
+        ModelConfig(seq_impl="ulysses"),
+    )
+    assert ulysses.required == {"all-to-all"}
+    ep = expected_budget(MeshConfig(expert=4, strategy="no_shard"))
+    assert ep.required == {"all-to-all"}
+    pipe = expected_budget(MeshConfig(pipe=2, strategy="no_shard"))
+    assert pipe.required == {"collective-permute"}
+    # all-reduce is tolerated (metrics reductions), never forbidden.
+    for b in (fsdp, z2, ring, ep, pipe):
+        assert "all-reduce" not in b.forbidden
+
+
+def test_check_budget_missing_forbidden_and_caps():
+    found = {"all-gather": ["all-gather.1", "all-gather.2"]}
+    budget = CollectiveBudget(
+        required={"all-reduce"}, forbidden={"all-gather"}
+    )
+    codes = [f.code for f in check_budget(found, budget)]
+    assert codes == ["missing-collective", "forbidden-collective"]
+
+    capped = CollectiveBudget(max_counts={"all-gather": 1})
+    codes = [f.code for f in check_budget(found, capped)]
+    assert codes == ["budget-exceeded"]
+    assert not check_budget(
+        found, CollectiveBudget(max_counts={"all-gather": 2})
+    )
+
+
+def test_check_budget_cross_checks_trace_classifier():
+    found = {"all-reduce": ["fusion.1"]}  # name a classifier can't see
+    findings = check_budget(
+        found, CollectiveBudget(required={"all-reduce"}),
+        classify=classify_op,
+    )
+    assert [f.code for f in findings] == ["unclassified-collective"]
+    ok = {"all-reduce": ["all-reduce.3"]}
+    assert not check_budget(
+        ok, CollectiveBudget(required={"all-reduce"}), classify=classify_op
+    )
+
+
+def test_budget_rejects_unknown_and_contradictory_opcodes():
+    with pytest.raises(ValueError, match="unknown collective"):
+        CollectiveBudget(required={"all-shuffle"})
+    with pytest.raises(ValueError, match="required and forbidden"):
+        CollectiveBudget(
+            required={"all-reduce"}, forbidden={"all-reduce"}
+        )
+
+
+# -------------------------------------------------- broken-fixture audits
+
+def _donated_step():
+    def step(state, x):
+        w = state["w"]
+        return {"w": w - 0.1 * (w @ x)}, jnp.sum(w)
+
+    args = ({"w": jnp.ones((8, 8))}, jnp.ones((8, 8)))
+    return step, args
+
+
+def test_donation_auditor_passes_donated_and_catches_dropped():
+    step, args = _donated_step()
+    good = audit_program(
+        jax.jit(step, donate_argnums=(0,)), args, label="donated"
+    )
+    assert good.clean(), good.table()
+    assert good.summary["donation"]["aliased"] == 1
+
+    # BROKEN fixture: the same step jitted WITHOUT donate_argnums.
+    # repolint: allow(jit-donation-decision) — the defect under test.
+    bad = audit_program(jax.jit(step), args, label="dropped")
+    assert not bad.clean()
+    assert [f.code for f in bad.errors] == ["not-donated"]
+
+
+def test_collective_auditor_catches_injected_all_gather(eight_devices):
+    mesh = jax.sharding.Mesh(np.array(eight_devices), axis_names=("data",))
+    budget = expected_budget(MeshConfig(data=8, strategy="no_shard"))
+
+    def ddp_like(state, x):
+        g = state["w"] * x.sum()
+        return {"w": state["w"] - jax.lax.pmean(g, "data")}
+
+    def with_extra_gather(state, x):
+        g = state["w"] * jax.lax.all_gather(x, "data").sum()
+        return {"w": state["w"] - jax.lax.pmean(g, "data")}
+
+    args = ({"w": jnp.ones((8, 4))}, jnp.ones((8, 4)))
+    specs = ({"w": P("data")}, P("data"))
+
+    def jit_of(fn):
+        return jax.jit(
+            shard_map(
+                fn, mesh=mesh, in_specs=specs, out_specs={"w": P("data")}
+            ),
+            donate_argnums=(0,),
+        )
+
+    good = audit_program(jit_of(ddp_like), args, budget, label="ddp-like")
+    assert good.clean(), good.table()
+    assert "all-reduce" in good.summary["collective_counts"]
+
+    # BROKEN fixture: a sharding edit snuck an all-gather into DDP.
+    bad = audit_program(
+        jit_of(with_extra_gather), args, budget, label="extra-gather"
+    )
+    assert not bad.clean()
+    assert "forbidden-collective" in [f.code for f in bad.errors]
+
+
+def test_dtype_auditor_catches_f32_leak_in_bf16_program():
+    def clean_bf16(a, b):
+        return a @ b
+
+    def leaky(a, b):
+        # The planted leak: an upcast ahead of the matmul.
+        return (a.astype(jnp.float32) @ b.astype(jnp.float32)).astype(
+            jnp.bfloat16
+        )
+
+    args = (
+        jnp.ones((8, 8), jnp.bfloat16),
+        jnp.ones((8, 8), jnp.bfloat16),
+    )
+    ok = audit_program(
+        jax.jit(clean_bf16), args, compute_dtype="bfloat16",
+        expect_donation=False, label="bf16-clean",
+    )
+    assert ok.clean(), ok.table()
+    bad = audit_program(
+        jax.jit(leaky), args, compute_dtype="bfloat16",
+        expect_donation=False, label="bf16-leak",
+    )
+    assert [f.code for f in bad.errors] == ["f32-dot-leak"]
+
+
+def test_hazard_auditor_catches_callback_in_hot_loop():
+    def hot_print(x):
+        def body(i, acc):
+            jax.debug.print("i={i}", i=i)
+            return acc + x
+
+        return jax.lax.fori_loop(0, 4, body, x)
+
+    report = audit_program(
+        jax.jit(hot_print), (jnp.ones(()),), expect_donation=False,
+        label="hot-print",
+    )
+    assert "callback-in-hot-loop" in [f.code for f in report.errors]
+
+
+def test_hazard_auditor_warns_on_weak_typed_scalar_args():
+    report = audit_program(
+        jax.jit(lambda x, y: x * y), (jnp.ones(()), 3.0),
+        expect_donation=False, label="weak",
+    )
+    assert report.clean()  # warn, not error
+    assert "weak-typed-input" in [f.code for f in report.warnings]
+
+
+def test_trace_summary_sees_convert_chain():
+    def chain(a):
+        return a.astype(jnp.float32).astype(jnp.bfloat16)
+
+    s = trace_summary(jax.jit(chain), (jnp.ones((4,), jnp.bfloat16),))
+    assert any(c.chained for c in s.converts)
+
+
+def test_audit_fixture_one_liner(audit):
+    step, args = _donated_step()
+    audit.assert_clean(
+        jax.jit(step, donate_argnums=(0,)), args, NO_COLLECTIVES
+    )
+    with pytest.raises(AssertionError):
+        # repolint: allow(jit-donation-decision) — the defect under test.
+        audit.assert_clean(jax.jit(step), args, NO_COLLECTIVES)
+
+
+# ---------------------------------------------------------------- repolint
+
+def _lint(src: str, library: bool = True):
+    return lint_source(textwrap.dedent(src), "synthetic.py", library=library)
+
+
+def test_repolint_donation_rule_and_allow():
+    bad = _lint("""\
+        import jax
+        step = jax.jit(lambda s: s)
+        """)
+    assert [v.rule for v in bad] == ["jit-donation-decision"]
+    good = _lint("""\
+        import jax
+        step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+        """)
+    assert not good
+    allowed = _lint("""\
+        import jax
+        # repolint: allow(jit-donation-decision) — eval params must survive
+        ev = jax.jit(lambda p, b: b)
+        """)
+    assert not allowed
+    bare = _lint("""\
+        import jax
+        ev = jax.jit(lambda p, b: b)  # repolint: allow(jit-donation-decision)
+        """)
+    # A bare allow (no reason) is itself flagged AND does not suppress.
+    assert len(bare) == 2
+    assert any("without a reason" in v.message for v in bare)
+
+
+def test_repolint_host_sync_and_wallclock_in_traced():
+    src = """\
+        import jax, time
+        import numpy as np
+
+        def step_fn(state):
+            t0 = time.time()
+            host = np.asarray(state)
+            return host, t0
+
+        step = jax.jit(step_fn, donate_argnums=(0,))
+        """
+    rules = sorted(v.rule for v in _lint(src))
+    assert rules == ["host-sync-in-traced", "wallclock-in-traced"]
+    # The same body NOT passed to jit lints clean.
+    clean = _lint("""\
+        import time
+        import numpy as np
+
+        def host_helper(state):
+            return np.asarray(state), time.time()
+        """)
+    assert not clean
+
+
+def test_repolint_traced_via_partial_decorator():
+    src = """\
+        import functools
+        import jax
+
+        @functools.partial(jax.jit, static_argnames=("n",), donate_argnums=(0,))
+        def gen(state, n):
+            return jax.device_get(state)
+        """
+    assert [v.rule for v in _lint(src)] == ["host-sync-in-traced"]
+
+
+def test_repolint_bare_jit_decorator_needs_decision():
+    src = """\
+        import jax
+
+        @jax.jit
+        def step(state):
+            return state
+        """
+    assert [v.rule for v in _lint(src)] == ["jit-donation-decision"]
+    allowed = _lint("""\
+        import jax
+
+        # repolint: allow(jit-donation-decision) — pure fn, inputs reused
+        @jax.jit
+        def step(state):
+            return state
+        """)
+    assert not allowed
+
+
+def test_audit_handles_static_arg_programs():
+    """Entry points jitted with static_argnames (the decode/generate
+    family) must audit without crashing: .trace() honours statics where
+    make_jaxpr would feed them tracers."""
+    import functools
+
+    @functools.partial(jax.jit, static_argnames=("n",))
+    # repolint: allow(jit-donation-decision) — test fixture, no state
+    def gen(x, n):
+        return x * n
+
+    report = audit_program(
+        gen, (jnp.ones((4,), jnp.bfloat16), 3), expect_donation=False,
+        compute_dtype="bfloat16", label="static-args",
+    )
+    assert report.clean(), report.table()
+    assert "dot_dtypes" in report.summary  # jaxpr scan actually ran
+
+
+def test_repolint_debug_callback_library_only():
+    src = """\
+        import jax
+        def helper(x):
+            jax.debug.print("x={x}", x=x)
+            return x
+        """
+    assert [v.rule for v in _lint(src, library=True)] == [
+        "debug-callback-in-library"
+    ]
+    assert not _lint(src, library=False)  # scripts/tests may debug freely
+
+
+def test_repolint_repo_is_clean():
+    from pathlib import Path
+
+    from pytorch_distributed_tpu.analysis.repolint import lint_paths
+
+    repo = Path(__file__).resolve().parents[1]
+    violations = lint_paths(
+        [repo / "pytorch_distributed_tpu", repo / "scripts"], repo
+    )
+    assert not violations, "\n".join(str(v) for v in violations)

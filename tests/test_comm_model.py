@@ -158,3 +158,89 @@ def test_project_ring_mfu_bands_sane():
     # tok/s ordering mirrors the step band.
     t_lo, t_hi = r["tokps_per_chip_band"]
     assert t_lo <= t_hi
+
+
+# ---- edge cases: mesh size 1, non-power-of-two meshes, band ordering ----
+
+
+def test_mesh_size_one_all_strategies_zero_traffic():
+    """A 1-chip 'mesh' has nobody to talk to: every traffic model must
+    return exactly zero for every component, not just 'total'."""
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        ring_attention_comm_bytes_per_step,
+    )
+
+    for t in (
+        fsdp_comm_bytes_per_step(10**9, 1),
+        ddp_comm_bytes_per_step(10**9, 1),
+        ring_attention_comm_bytes_per_step(
+            n_layer=4, batch=2, t_local=8, kv_dim=4, n_chips=1
+        ),
+    ):
+        assert all(v == 0.0 for v in t.values()), t
+
+
+def test_non_power_of_two_meshes():
+    """TPU slices come in non-power-of-two shapes too (v5e-12, 3x4
+    meshes); the (N-1)/N ring accounting must hold exactly there."""
+    for n in (3, 5, 6, 12):
+        frac = (n - 1) / n
+        f = fsdp_comm_bytes_per_step(1000, n, param_bytes=2)
+        assert f["all_gather"] == pytest.approx(2 * 1000 * 2 * frac)
+        assert f["reduce_scatter"] == pytest.approx(1000 * 2 * frac)
+        d = ddp_comm_bytes_per_step(1000, n, grad_bytes=4)
+        assert d["all_reduce"] == pytest.approx(2 * 1000 * 4 * frac)
+    # Traffic stays monotone through the non-power-of-two points.
+    seq = [
+        fsdp_comm_bytes_per_step(10**6, n)["total"] for n in (2, 3, 5, 6, 12)
+    ]
+    assert seq == sorted(seq)
+
+
+def test_non_power_of_two_memory_sharding():
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        zero_memory_per_chip,
+    )
+
+    z = zero_memory_per_chip(999, 3, strategy="full_shard", param_bytes=2)
+    assert z["params"] == pytest.approx(999 * 2 / 3)
+    assert z["total"] == pytest.approx((999 * 2 + 999 * 2 + 999 * 4) / 3)
+
+
+def test_band_ordering_invariant_across_overlap_regimes():
+    """The projection band is [full-overlap fast-BW, no-overlap slow-BW]:
+    best <= worst must hold in BOTH regimes — comm-dominated (comm >>
+    compute: best == comm_fast) and compute-dominated (compute >> comm:
+    best == compute) — and the no-overlap bound is always the plain sum."""
+    for comm_bytes, compute_ms in (
+        (50e9, 1.0),  # comm-dominated
+        (1e6, 100.0),  # compute-dominated
+        (0.0, 10.0),  # no communication at all: band collapses
+    ):
+        proj = project_step(
+            comm_bytes=comm_bytes, compute_ms=compute_ms, chip=V5E
+        )
+        fast, slow = proj["comm_ms_band"]
+        best, worst = proj["step_ms_band"]
+        assert fast <= slow
+        assert best <= worst
+        assert best == pytest.approx(max(compute_ms, fast))
+        assert worst == pytest.approx(compute_ms + slow)
+    zero = project_step(comm_bytes=0.0, compute_ms=10.0, chip=V5E)
+    assert zero["step_ms_band"] == (10.0, pytest.approx(10.0))
+
+
+def test_mfu_band_ordering_tracks_step_band():
+    """mfu_pct_band must be (lo, hi) with lo from the WORST step time —
+    the ordering invariant that keeps RESULTS.md tables honest — at
+    power-of-two and non-power-of-two chip counts alike."""
+    for n in (2, 3, 6, 8, 64):
+        proj = project_fsdp_mfu(
+            n_params=10**9, n_chips=n, measured_ms_per_step=100.0,
+            measured_mfu_pct=50.0,
+        )
+        lo, hi = proj["mfu_pct_band"]
+        best_ms, worst_ms = proj["step_ms_band"]
+        assert 0 < lo <= hi <= 50.0
+        assert lo == pytest.approx(50.0 * 100.0 / worst_ms)
+        assert hi == pytest.approx(50.0 * 100.0 / best_ms)

@@ -18,12 +18,11 @@ device tracks), and asserts
       ring -> collective-permute, EP -> all-to-all ...).
 """
 
-import re
-
 import jax
 import numpy as np
 import pytest
 
+from pytorch_distributed_tpu.analysis import collective_instructions
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from pytorch_distributed_tpu.models import get_model
 from pytorch_distributed_tpu.parallel import make_mesh, shard_train_state
@@ -37,35 +36,6 @@ from pytorch_distributed_tpu.utils.prng import domain_key
 # Heavy tier: long-compiling / multi-process file; excluded from
 # `pytest -m quick` (see tests/conftest.py + pyproject markers).
 pytestmark = pytest.mark.full
-
-# Every HLO collective opcode (base form; XLA also emits async -start/-done
-# pairs whose instruction names contain the base).
-HLO_COLLECTIVES = (
-    "all-reduce",
-    "all-gather",
-    "reduce-scatter",
-    "collective-permute",
-    "all-to-all",
-    "collective-broadcast",
-    "ragged-all-to-all",
-)
-
-
-def _collective_instrs(hlo_text: str) -> dict[str, list[str]]:
-    """{base_opcode: [instruction names]} for every collective instruction
-    in the compiled module text."""
-    found: dict[str, list[str]] = {}
-    for line in hlo_text.splitlines():
-        m = re.match(r"\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=", line)
-        if not m:
-            continue
-        rhs = line[m.end():]
-        for op in HLO_COLLECTIVES:
-            if re.search(rf"\b{op}(?:-start|-done)?\(", rhs):
-                found.setdefault(op, []).append(m.group(1))
-                break
-    return found
-
 
 def _tiny(n_experts: int = 0):
     kw = dict(
@@ -135,7 +105,7 @@ def test_emitted_collectives_classified_and_expected(
     eight_devices, label, mcfg, experts, expected
 ):
     hlo = _compiled_hlo(mcfg, n_experts=experts)
-    found = _collective_instrs(hlo)
+    found = collective_instructions(hlo)
     assert found, f"{label}: no collectives in compiled HLO"
     # (2) the strategy emits what its design promises (the notebook's
     # "expected collectives appear" oracle, reference analyze_traces.ipynb).
@@ -176,7 +146,7 @@ def test_pipeline_emits_classified_collectives(eight_devices):
         "targets": rng.integers(0, 128, (4, 4, 16)).astype(np.int32),
     }
     hlo = step.lower(state, batch, jax.random.key(0)).compile().as_text()
-    found = _collective_instrs(hlo)
+    found = collective_instructions(hlo)
     assert "collective-permute" in found, set(found)
     for names in found.values():
         for name in names:

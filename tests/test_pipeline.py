@@ -35,7 +35,11 @@ from pytorch_distributed_tpu.utils.prng import domain_key
 
 # Heavy tier: long-compiling file; excluded from `pytest -m quick`
 # (see tests/conftest.py + pyproject markers).
-pytestmark = pytest.mark.full
+# Heavy tier AND slow tier: these compile-bound equivalence batteries
+# dominate suite wall-clock; the tier-1 CI command (ROADMAP.md) runs
+# -m 'not slow' to stay inside its time budget — plain `pytest` and
+# nightly runs still execute them.
+pytestmark = [pytest.mark.full, pytest.mark.slow]
 
 
 @pytest.mark.parametrize("pipe,data", [(2, 1), (4, 1), (2, 2), (4, 2)])

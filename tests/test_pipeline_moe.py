@@ -19,7 +19,11 @@ from pytorch_distributed_tpu.parallel.pipeline import (
 from pytorch_distributed_tpu.train.state import init_train_state
 from pytorch_distributed_tpu.utils.prng import domain_key
 
-pytestmark = pytest.mark.full
+# Heavy tier AND slow tier: these compile-bound equivalence batteries
+# dominate suite wall-clock; the tier-1 CI command (ROADMAP.md) runs
+# -m 'not slow' to stay inside its time budget — plain `pytest` and
+# nightly runs still execute them.
+pytestmark = [pytest.mark.full, pytest.mark.slow]
 
 
 @pytest.mark.parametrize(

@@ -13,10 +13,9 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
+# compat: maps check_vma onto old-jax check_rep=False — the pre-vma
+# replication checker rejects ring attention's lax.cond carries.
+from pytorch_distributed_tpu.utils.compat import shard_map
 
 from pytorch_distributed_tpu.ops.attention import naive_attention
 from pytorch_distributed_tpu.ops.ring_attention import ring_attention

@@ -99,7 +99,10 @@ def test_fsdp_trace_has_real_collectives(traces):
     assert events, "CPU-runtime fallback found no op events"
     comm = [e for e in events if e["category"] == "communication"]
     assert comm, "no communication events classified"
-    names = {e["name"].split(".")[0] for e in comm}
+    # Normalise the compiler's spelling: newer CPU runtimes emit
+    # all_gather.N thunk rows, older ones the hyphenated HLO instruction
+    # names (all-gather.N) — same ops, same classification either way.
+    names = {e["name"].split(".")[0].replace("-", "_") for e in comm}
     # ZeRO-3's defining pair: just-in-time gather + AD-transposed
     # reduce-scatter, named by the compiler, not by us.
     assert any("all_gather" in n for n in names), names
@@ -131,7 +134,9 @@ def test_ops_diff_ddp_vs_fsdp(traces):
     diff = ops_diff(
         traces["ddp"], traces["fsdp"], only_categories={"communication"}
     )
-    added_roots = {n.split(".")[0] for n in diff["added"]}
+    added_roots = {
+        n.split(".")[0].replace("-", "_") for n in diff["added"]
+    }
     assert any("all_gather" in n for n in added_roots), diff["added"].keys()
     # DDP's grad all-reduce is communication too — present on its side.
     ddp_comm = [
