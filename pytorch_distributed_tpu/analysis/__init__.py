@@ -2,7 +2,9 @@
 
 Audits any jitted step function's jaxpr + optimized HLO without running
 it: collective budgets per parallelism strategy, donation/aliasing,
-dtype leaks, and recompilation/host-sync hazards. See docs/ANALYSIS.md.
+dtype leaks, recompilation/host-sync hazards, and the vma
+replication/varying-axes checker for shard_map bodies (our own
+``check_vma``, independent of the jax version). See docs/ANALYSIS.md.
 
 Entry points:
 - ``audit_program(fn, args, budget) -> AuditReport`` — library API;
@@ -35,6 +37,12 @@ from pytorch_distributed_tpu.analysis.report import (
     Finding,
     reports_to_json,
 )
+from pytorch_distributed_tpu.analysis.vma_check import (
+    VmaInterpreter,
+    check_shard_map_eqn,
+    check_vma_program,
+    find_shard_map_eqns,
+)
 
 __all__ = [
     "AuditReport",
@@ -42,14 +50,18 @@ __all__ = [
     "Finding",
     "HLO_COLLECTIVES",
     "NO_COLLECTIVES",
+    "VmaInterpreter",
     "audit_program",
     "check_budget",
     "check_donation",
     "check_dtype",
     "check_hazards",
+    "check_shard_map_eqn",
+    "check_vma_program",
     "collective_counts",
     "collective_instructions",
     "expected_budget",
+    "find_shard_map_eqns",
     "parse_input_output_aliases",
     "reports_to_json",
 ]

@@ -14,7 +14,13 @@ lowers + compiles it WITHOUT running it, and checks:
 4. recompilation / host-sync hazards — host callbacks
    (``jax.debug.print`` / ``io_callback`` / ``pure_callback``) inside the
    hot loop, weak-typed (Python-scalar) arguments that retrace when their
-   Python type changes.
+   Python type changes;
+5. vma (replication/varying-axes) — an abstract interpreter over every
+   ``shard_map`` body's jaxpr that re-derives which mesh axes each value
+   varies over and diffs the result against the out_specs: missing psums,
+   out_spec races, redundant collectives, collectives under divergent
+   control flow (analysis/vma_check.py). Our own replication checker,
+   independent of whether the rig's jax ships ``check_vma``.
 
 The checkers are pure functions over the lowered artifacts, so everything
 runs on the CPU test rig (``JAX_PLATFORMS=cpu`` + virtual devices) against
@@ -33,35 +39,31 @@ from pytorch_distributed_tpu.analysis.hlo import (
     aliased_param_numbers,
     collective_instructions,
 )
-from pytorch_distributed_tpu.analysis.jaxpr_scan import (
-    JaxprSummary,
-    trace_summary,
-)
+from pytorch_distributed_tpu.analysis.jaxpr_scan import JaxprSummary
 from pytorch_distributed_tpu.analysis.report import AuditReport, Finding
+from pytorch_distributed_tpu.analysis.vma_check import check_vma_program
 from pytorch_distributed_tpu.profiling.trace_analysis import classify_op
 
-ALL_CHECKS = ("collectives", "donation", "dtype", "hazards")
+ALL_CHECKS = ("collectives", "donation", "dtype", "hazards", "vma")
 
 
 def _leaf_count(tree) -> int:
     return len(jax.tree.leaves(tree))
 
 
-def _program_summary(jitted, args) -> JaxprSummary | None:
-    """Jaxpr scan of a jitted program. Prefers ``jitted.trace(*args)``,
-    which respects static_argnums/static_argnames (``jax.make_jaxpr``
-    would feed tracers into the static slots and crash on e.g. the decode
-    entry points); falls back to make_jaxpr for plain callables, and to
-    None when neither can trace the signature."""
-    from pytorch_distributed_tpu.analysis.jaxpr_scan import scan_jaxpr
-
+def _program_jaxpr(jitted, args):
+    """Traced (closed) jaxpr of a jitted program. Prefers
+    ``jitted.trace(*args)``, which respects static_argnums/static_argnames
+    (``jax.make_jaxpr`` would feed tracers into the static slots and crash
+    on e.g. the decode entry points); falls back to make_jaxpr for plain
+    callables, and to None when neither can trace the signature."""
     if hasattr(jitted, "trace"):
         try:
-            return scan_jaxpr(jitted.trace(*args).jaxpr)
+            return jitted.trace(*args).jaxpr
         except Exception:
             pass
     try:
-        return trace_summary(jitted, args)
+        return jax.make_jaxpr(jitted)(*args)
     except Exception:
         return None
 
@@ -263,6 +265,7 @@ def audit_program(
     compute_dtype: str | None = None,
     allowed_f32_dots: int = 0,
     checks: tuple[str, ...] = ALL_CHECKS,
+    vma_allow: dict[str, str] | None = None,
 ) -> AuditReport:
     """Audit a jitted program's jaxpr + optimized HLO without running it.
 
@@ -276,6 +279,9 @@ def audit_program(
     ``compute_dtype``: the activation dtype the program is configured for
     (ModelConfig.dtype); dtype checks only engage for low-precision
     programs.
+    ``vma_allow``: {finding code: reason} — downgrade the named vma
+    findings to info with the reason attached (the audit-level analogue of
+    a repolint allow-comment: the decision stays visible in the report).
     """
     unknown = set(checks) - set(ALL_CHECKS)
     if unknown:
@@ -284,17 +290,23 @@ def audit_program(
     # donation is the audited call site's contract, and forcing it here
     # would change the very alias accounting being audited.
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-    lowered = jitted.lower(*args)
-    compiled = lowered.compile()
-    hlo_text = compiled.as_text()
 
     report = AuditReport(label=label or getattr(fn, "__name__", "program"))
     report.summary["platform"] = jax.default_backend()
 
-    found = collective_instructions(hlo_text)
-    report.summary["collective_counts"] = {
-        op: len(names) for op, names in found.items()
-    }
+    # The HLO-level checks need a full XLA compile; the jaxpr-level ones
+    # (dtype/hazards/vma) only need a trace — so e.g.
+    # ``scripts/audit.py --only vma`` runs compile-free.
+    need_hlo = "collectives" in checks or (
+        "donation" in checks and expect_donation
+    )
+    if need_hlo:
+        compiled = jitted.lower(*args).compile()
+        hlo_text = compiled.as_text()
+        found = collective_instructions(hlo_text)
+        report.summary["collective_counts"] = {
+            op: len(names) for op, names in found.items()
+        }
     if "collectives" in checks and budget is not None:
         report.extend(check_budget(found, budget, classify=classify_op))
         report.summary["budget"] = {
@@ -315,23 +327,86 @@ def audit_program(
         report.extend(findings)
         report.summary["donation"] = stats
 
-    if "dtype" in checks or "hazards" in checks:
-        summary = _program_summary(jitted, args)
-        if summary is None:
+    jaxpr = None
+    summary = None
+    if {"dtype", "hazards", "vma"} & set(checks):
+        from pytorch_distributed_tpu.analysis.jaxpr_scan import scan_jaxpr
+
+        jaxpr = _program_jaxpr(jitted, args)
+        if jaxpr is None:
+            # When the HLO checks also ran, partial coverage is noted as
+            # info (the decode-family static-arg audits); when EVERY
+            # requested check needed the jaxpr, the audit would be
+            # vacuous — fail loudly so e.g. a `--only vma` CI gate
+            # cannot go silently green on an unchecked program.
+            vacuous = not need_hlo
             report.findings.append(
                 Finding(
                     checker="hazards",
                     code="jaxpr-unavailable",
-                    severity="info",
+                    severity="error" if vacuous else "info",
                     message=(
                         "could not trace a jaxpr for this program "
                         "(static-argument signature the tracer cannot "
-                        "re-enter); dtype/hazard checks skipped"
+                        "re-enter); dtype/hazard/vma checks skipped"
+                        + (
+                            " — and no other check ran, so this audit "
+                            "verified NOTHING" if vacuous else ""
+                        )
                     ),
                 )
             )
-    else:
-        summary = None
+        elif {"dtype", "hazards"} & set(checks):
+            # A scanner crash on one program must degrade to a finding,
+            # not abort the whole `--all` run (the pre-refactor
+            # _program_summary swallowed these into jaxpr-unavailable).
+            try:
+                summary = scan_jaxpr(jaxpr)
+            except Exception as e:
+                summary = None
+                report.findings.append(
+                    Finding(
+                        checker="hazards",
+                        code="jaxpr-scan-failed",
+                        severity="warn",
+                        message=(
+                            f"jaxpr scanner crashed on this program "
+                            f"({e!r}); dtype/hazard checks skipped"
+                        ),
+                    )
+                )
+
+    if jaxpr is not None and "vma" in checks:
+        try:
+            vma_findings, vma_summary = check_vma_program(jaxpr)
+        except Exception as e:
+            # An error, not a warn: a crashed replication checker means
+            # the program is UNVERIFIED, and the vma CI gate must not
+            # report it green.
+            vma_findings, vma_summary = None, None
+            report.findings.append(
+                Finding(
+                    checker="vma",
+                    code="vma-check-failed",
+                    severity="error",
+                    message=(
+                        f"vma checker crashed on this program ({e!r}) — "
+                        "its replication invariants are UNVERIFIED"
+                    ),
+                )
+            )
+        if vma_findings is not None:
+            allow = vma_allow or {}
+            for f in vma_findings:
+                if f.code in allow:
+                    f = Finding(
+                        checker=f.checker, code=f.code, severity="info",
+                        message=f"{f.message} [allowed: {allow[f.code]}]",
+                        detail=f.detail,
+                    )
+                report.findings.append(f)
+            report.summary["vma"] = vma_summary
+
     if summary is not None:
         report.summary["dot_dtypes"] = summary.dot_dtype_histogram()
         report.summary["hazards"] = {

@@ -55,6 +55,33 @@ NO_COLLECTIVES = CollectiveBudget(
 )
 
 
+# Instruction-count ceilings for the registered cases whose collective
+# count IS the perf contract, measured once on the tiny registry models
+# (XLA:CPU, jax 0.4.37) and pinned. The numbers are per-HLO-module
+# instruction counts, not logical collectives: XLA emits one all-reduce
+# per psum operand, so DDP's "ONE gradient all-reduce" (a single variadic
+# psum over the 15-leaf grad tree) plus the loss/metric reductions lands
+# at 17 instructions; ZeRO-3's just-in-time gathers are per-leaf, per
+# direction (forward gather + remat re-gather in backward), and its
+# reduce-scatters are the gathers' AD transposes. A future edit that
+# re-gathers params twice, loses the accumulate-locally/reduce-once
+# structure, or sneaks a second grad reduction blows the ceiling.
+STABLE_MAX_COUNTS: dict[str, dict[str, int]] = {
+    "ddp": {"all-reduce": 17},
+    "fsdp": {"all-gather": 27, "reduce-scatter": 16, "all-reduce": 2},
+}
+
+
+def pin_max_counts(budget: CollectiveBudget, case: str) -> CollectiveBudget:
+    """``budget`` with the STABLE_MAX_COUNTS ceilings for ``case``."""
+    counts = STABLE_MAX_COUNTS[case]
+    return dataclasses.replace(
+        budget,
+        max_counts={**budget.max_counts, **counts},
+        note=f"{budget.note}; max_counts pinned ({case})".strip("; "),
+    )
+
+
 def expected_budget(
     mesh_cfg: MeshConfig, model_cfg: ModelConfig | None = None
 ) -> CollectiveBudget:

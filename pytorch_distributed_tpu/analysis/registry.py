@@ -6,8 +6,12 @@ tests/test_hlo_collectives.py compiles), paired with the collective budget
 its strategy implies. Consumed by ``scripts/audit.py --all`` and by tests.
 
 Every case builds a REAL step function from the production builders
-(train/trainer.py, parallel/explicit.py, parallel/pipeline.py) — the audit
-runs against the exact programs training runs, not stand-ins.
+(train/trainer.py, parallel/explicit.py, parallel/pipeline.py,
+parallel/api.py) — the audit runs against the exact programs training
+runs, not stand-ins. Each explicit (shard_map) case has a pjit twin so
+both placement paths stay audited; the ddp/fsdp budgets carry measured
+``max_counts`` instruction ceilings (budget.STABLE_MAX_COUNTS) and
+ddp_bf16 pins the ``allowed_f32_dots=0`` low-precision contract.
 """
 
 from __future__ import annotations
@@ -20,8 +24,10 @@ import numpy as np
 
 from pytorch_distributed_tpu.analysis.budget import (
     NO_COLLECTIVES,
+    STABLE_MAX_COUNTS,
     CollectiveBudget,
     expected_budget,
+    pin_max_counts,
 )
 from pytorch_distributed_tpu.config import (
     MeshConfig,
@@ -39,13 +45,16 @@ class AuditCase:
     build: Callable[[], tuple]
 
 
-def _tiny(n_experts: int = 0, dtype: str = "float32") -> ModelConfig:
+def _tiny(
+    n_experts: int = 0, dtype: str = "float32", **overrides
+) -> ModelConfig:
     kw = dict(
         vocab_size=128, n_ctx=16, n_embd=64, n_layer=2, n_head=4,
         dtype=dtype, embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
     )
     if n_experts:
         kw.update(n_experts=n_experts, expert_capacity_factor=8.0)
+    kw.update(overrides)
     return ModelConfig(**kw)
 
 
@@ -80,7 +89,12 @@ def _build_baseline():
     return step, args, NO_COLLECTIVES, {"compute_dtype": cfg.dtype}
 
 
-def _build_explicit(mcfg: MeshConfig, n_experts: int = 0):
+def _build_explicit(
+    mcfg: MeshConfig,
+    n_experts: int = 0,
+    budget_case: str | None = None,
+    **model_overrides,
+):
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.parallel import make_mesh, shard_train_state
     from pytorch_distributed_tpu.parallel.explicit import (
@@ -91,7 +105,7 @@ def _build_explicit(mcfg: MeshConfig, n_experts: int = 0):
     from pytorch_distributed_tpu.train.state import init_train_state
     from pytorch_distributed_tpu.utils.prng import domain_key
 
-    cfg = _tiny(n_experts)
+    cfg = _tiny(n_experts, **model_overrides)
     model = get_model(cfg)
     tx = make_optimizer(_tcfg())
     mesh = make_mesh(mcfg)
@@ -100,9 +114,16 @@ def _build_explicit(mcfg: MeshConfig, n_experts: int = 0):
     step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
     batch = make_batch_put(mesh, mcfg)(_batch())
     args = (state, batch, jax.random.key(0))
-    return step, args, expected_budget(mcfg, cfg), {
-        "compute_dtype": cfg.dtype
-    }
+    budget = expected_budget(mcfg, cfg)
+    if budget_case is not None:
+        budget = pin_max_counts(budget, budget_case)
+    audit_kwargs = {"compute_dtype": cfg.dtype}
+    if cfg.dtype == "bfloat16":
+        # The bf16 contract: ZERO all-f32 matmuls. The f32-OUT dots the
+        # histogram shows are bf16-in/f32-out (MXU accumulation + the
+        # f32 logits head) — allowed by design, not counted as leaks.
+        audit_kwargs["allowed_f32_dots"] = 0
+    return step, args, budget, audit_kwargs
 
 
 def _build_pipeline(schedule: str):
@@ -126,11 +147,46 @@ def _build_pipeline(schedule: str):
     mesh = make_mesh(mcfg)
     state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
     state, _ = shard_pipeline_state(state, mesh, mcfg)
-    step = make_pipeline_train_step(model, cfg, tx, mesh, mcfg, state, tcfg)
+    step = make_pipeline_train_step(
+        model, cfg, tx, mesh, mcfg, state, tcfg, schedule=schedule
+    )
     args = (state, _batch(shape=(4, 4, 16)), jax.random.key(0))
     return step, args, expected_budget(mcfg, cfg), {
         "compute_dtype": cfg.dtype
     }
+
+
+def _build_pjit(mcfg: MeshConfig, n_experts: int = 0, budget="derive"):
+    """The parallel/api.py (pjit/NamedSharding) twin of an explicit case.
+
+    The pjit path's collectives are PLACED BY the SPMD partitioner, so
+    for most strategies the emitted op set is a partitioner choice (e.g.
+    ZeRO-2 resharding through all-to-all + all-gather on the CPU
+    backend), not a written contract — those twins carry a relaxed
+    budget (or none) and are equivalence-tested numerically instead; the
+    donation/dtype/hazard/vma checks run at full strength either way
+    (vma is vacuous here: no shard_map bodies — the partitioner owns
+    replication, which is exactly why the explicit path needs vma-check
+    and this one doesn't)."""
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh
+    from pytorch_distributed_tpu.parallel.api import make_parallel_train_step
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _tiny(n_experts)
+    model = get_model(cfg)
+    tx = make_optimizer(_tcfg())
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(42, "init"), cfg), tx)
+    step, batch_put = make_parallel_train_step(
+        model, cfg, tx, mesh, mcfg, state
+    )
+    args = (state, batch_put(_batch()), jax.random.key(0))
+    if budget == "derive":
+        budget = expected_budget(mcfg, cfg)
+    return step, args, budget, {"compute_dtype": cfg.dtype}
 
 
 def registered_cases() -> dict[str, AuditCase]:
@@ -144,18 +200,27 @@ def registered_cases() -> dict[str, AuditCase]:
         ),
         AuditCase(
             "ddp",
-            "explicit DDP: data=8, no_shard",
+            "explicit DDP: data=8, no_shard (max_counts pinned)",
             8,
             lambda: _build_explicit(
-                MeshConfig(data=8, strategy="no_shard")
+                MeshConfig(data=8, strategy="no_shard"), budget_case="ddp"
+            ),
+        ),
+        AuditCase(
+            "ddp_bf16",
+            "explicit DDP in bf16 compute: allowed_f32_dots=0 pinned",
+            8,
+            lambda: _build_explicit(
+                MeshConfig(data=8, strategy="no_shard"), dtype="bfloat16"
             ),
         ),
         AuditCase(
             "fsdp",
-            "explicit ZeRO-3: fsdp=8, full_shard",
+            "explicit ZeRO-3: fsdp=8, full_shard (max_counts pinned)",
             8,
             lambda: _build_explicit(
-                MeshConfig(fsdp=8, strategy="full_shard")
+                MeshConfig(fsdp=8, strategy="full_shard"),
+                budget_case="fsdp",
             ),
         ),
         AuditCase(
@@ -183,6 +248,15 @@ def registered_cases() -> dict[str, AuditCase]:
             ),
         ),
         AuditCase(
+            "ulysses",
+            "Ulysses sequence parallelism: seq=4, head/seq all-to-all",
+            4,
+            lambda: _build_explicit(
+                MeshConfig(seq=4, strategy="no_shard"),
+                seq_impl="ulysses",
+            ),
+        ),
+        AuditCase(
             "ep",
             "expert parallelism: expert=4, 4-expert MoE",
             4,
@@ -196,9 +270,85 @@ def registered_cases() -> dict[str, AuditCase]:
             2,
             _build_pipeline_gpipe,
         ),
+        AuditCase(
+            "pipeline_1f1b",
+            "1F1B (PipeDream-flush) pipeline: pipe=2, hand-scheduled",
+            2,
+            _build_pipeline_1f1b,
+        ),
+        # pjit twins of the explicit cases (parallel/api.py). Budgets per
+        # _build_pjit's docstring: derived where the partitioner's op set
+        # is the written contract, relaxed/none where it reshards freely.
+        AuditCase(
+            "ddp_pjit",
+            "pjit twin of ddp: partitioner-placed gradient all-reduce",
+            8,
+            lambda: _build_pjit(MeshConfig(data=8, strategy="no_shard")),
+        ),
+        AuditCase(
+            "fsdp_pjit",
+            "pjit twin of fsdp (ZeRO-3): param all-gather pinned",
+            8,
+            lambda: _build_pjit(
+                MeshConfig(fsdp=8, strategy="full_shard"),
+                budget=CollectiveBudget(
+                    required={"all-gather"},
+                    note="ZeRO-3 must gather params; the partitioner "
+                         "reshards grads via its own op choice "
+                         "(all-to-all/all-reduce on the CPU backend)",
+                ),
+            ),
+        ),
+        AuditCase(
+            "zero2_pjit",
+            "pjit twin of zero2: grad reduction pinned",
+            8,
+            lambda: _build_pjit(
+                MeshConfig(fsdp=8, strategy="shard_grad_op"),
+                budget=CollectiveBudget(
+                    required={"all-reduce"},
+                    note="ZeRO-2 under the partitioner: sharded-grad "
+                         "resharding is its op choice; only the "
+                         "reduction itself is pinned",
+                ),
+            ),
+        ),
+        AuditCase(
+            "tp_pjit",
+            "pjit twin of tp: Megatron psums placed by the partitioner",
+            4,
+            lambda: _build_pjit(MeshConfig(tensor=4, strategy="no_shard")),
+        ),
+        AuditCase(
+            "ring_pjit",
+            "pjit twin of ring: partitioner-chosen attention resharding "
+            "(no op contract; audited for donation/dtype/hazards)",
+            4,
+            lambda: _build_pjit(
+                MeshConfig(seq=4, strategy="no_shard"), budget=None
+            ),
+        ),
+        AuditCase(
+            "ep_pjit",
+            "pjit twin of ep: expert dispatch all-to-all pinned",
+            4,
+            lambda: _build_pjit(
+                MeshConfig(expert=4, strategy="no_shard"),
+                n_experts=4,
+                budget=CollectiveBudget(
+                    required={"all-to-all"},
+                    note="expert dispatch; other resharding is the "
+                         "partitioner's choice",
+                ),
+            ),
+        ),
     ]
     return {c.name: c for c in cases}
 
 
 def _build_pipeline_gpipe():
     return _build_pipeline("gpipe")
+
+
+def _build_pipeline_1f1b():
+    return _build_pipeline("1f1b")

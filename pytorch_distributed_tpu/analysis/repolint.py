@@ -1,9 +1,12 @@
 """Repo-rule AST lint: project invariants a reviewer should never have to
 re-litigate.
 
-Rules (each suppressible per line with ``# repolint: allow(<rule>) — why``
-on the offending line or the line above; the reason is REQUIRED — a bare
-allow is itself a violation):
+Rules (each suppressible with ``# repolint: allow(<rule>) — why`` on the
+FIRST or LAST line of the offending expression — i.e. the flagged line
+itself or trailing the closing paren of a continued call — or in the
+comment block above it; interior lines do not bind, so an allow on a
+nested call cannot waive the enclosing one. The reason is REQUIRED — a
+bare allow is itself a violation):
 
 - ``jit-donation-decision`` — every ``jax.jit`` call site / decorator
   must either pass ``donate_argnums``/``donate_argnames`` or carry an
@@ -127,13 +130,31 @@ def _traced_functions(tree: ast.AST) -> list[ast.FunctionDef]:
     return out
 
 
-def _allowed(lines: list[str], lineno: int, rule: str) -> bool:
-    """allow-comment (with a reason) on the line itself or in the
-    contiguous comment block immediately above it."""
-    if 1 <= lineno <= len(lines):
-        m = _ALLOW_RE.search(lines[lineno - 1])
-        if m and m.group(1) == rule:
-            return True
+def _allowed(
+    lines: list[str], lineno: int, rule: str, end_lineno: int | None = None
+) -> bool:
+    """allow-comment (with a reason) anywhere on the flagged expression's
+    line span, or in the contiguous comment block immediately above it.
+
+    The span matters for continued/parenthesized calls: ast reports the
+    violation at the opening line, but a human writes the allow as a
+    trailing comment after the closing paren —
+
+        step = jax.jit(
+            fn, static_argnames=("n",),
+        )  # repolint: allow(jit-donation-decision) — reason
+
+    — so the expression's FIRST and LAST lines are both searched. Only
+    those two (not every interior line): an allow trailing a nested call
+    on an interior line binds to the nested violation, and letting it
+    also waive the enclosing expression would silently suppress a
+    decision nobody reasoned about."""
+    last = max(lineno, end_lineno or lineno)
+    for ln in {lineno, last}:
+        if 1 <= ln <= len(lines):
+            m = _ALLOW_RE.search(lines[ln - 1])
+            if m and m.group(1) == rule:
+                return True
     ln = lineno - 1
     while 1 <= ln <= len(lines) and lines[ln - 1].lstrip().startswith("#"):
         m = _ALLOW_RE.search(lines[ln - 1])
@@ -172,8 +193,10 @@ def lint_source(
 
     violations: list[Violation] = []
 
-    def add(rule: str, lineno: int, message: str) -> None:
-        if not _allowed(lines, lineno, rule):
+    def add(
+        rule: str, lineno: int, message: str, end_lineno: int | None = None
+    ) -> None:
+        if not _allowed(lines, lineno, rule, end_lineno):
             violations.append(Violation(rule, path, lineno, message))
 
     for lineno, rule in _bare_allows(lines):
@@ -194,6 +217,7 @@ def lint_source(
                 call.lineno,
                 "jax.jit without donate_argnums — donate the step state, "
                 "or allowlist with the reason its inputs must survive",
+                end_lineno=getattr(call, "end_lineno", None),
             )
     # Bare `@jax.jit` decorators are not Call nodes and can never pass
     # donate_argnums, so they need an allow-comment just the same.
@@ -221,6 +245,7 @@ def lint_source(
                     node.lineno,
                     f"{name}() inside traced function {fn.name!r}: this "
                     "bakes a trace-time constant / forces a host sync",
+                    end_lineno=getattr(node, "end_lineno", None),
                 )
             elif name in _WALLCLOCK_CALLS:
                 add(
@@ -228,6 +253,7 @@ def lint_source(
                     node.lineno,
                     f"{name}() inside traced function {fn.name!r}: "
                     "evaluates once at trace time, frozen thereafter",
+                    end_lineno=getattr(node, "end_lineno", None),
                 )
 
     # Rule: debug callbacks in library code (anywhere in the module, traced
@@ -242,6 +268,7 @@ def lint_source(
                         node.lineno,
                         f"{name}() in library code: a host round-trip per "
                         "firing — gate it or move it to scripts/",
+                        end_lineno=getattr(node, "end_lineno", None),
                     )
     return violations
 

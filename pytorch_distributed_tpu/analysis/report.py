@@ -95,6 +95,13 @@ class AuditReport:
                 f"{haz.get('weak_type_inputs', 0)} weak-typed input(s), "
                 f"{haz.get('chained_converts', 0)} chained convert(s)"
             )
+        vma = self.summary.get("vma")
+        if vma is not None:
+            lines.append(
+                f"  vma:         {vma.get('shard_map_bodies', 0)} "
+                f"shard_map body(ies), {vma.get('outputs_checked', 0)} "
+                "output(s) checked"
+            )
         for f in self.findings:
             if f.severity == "info":
                 continue

@@ -1,0 +1,512 @@
+"""vma-check: static replication/varying-axes checker for shard_map bodies.
+
+The rig's jax predates the varying-manual-axes (vma) type system, so
+``utils/compat.py`` maps ``check_vma=True`` onto the UNCHECKED
+``check_rep=False`` — a missing ``psum`` (the cross-device divergence DDP's
+reducer exists to prevent) would train silently wrong. This module is our
+own replication checker, independent of the jax version: an abstract
+interpreter over the jaxpr of every ``shard_map`` body that propagates a
+per-value *varying axes* lattice through each equation.
+
+Lattice: each value maps to the ``frozenset`` of mesh axis names it may
+vary over (devices along that axis may hold DIFFERENT values). Join is
+set union; the interpretation is a forward may-analysis, so a reported
+invariant value really is replicated, while a reported varying value is
+only *possibly* varying (the safe direction for a race detector).
+
+Transfer rules:
+
+- shard_map inputs start varying over exactly the axes their ``in_specs``
+  shard them over (a replicated input is the same on every device);
+- elementwise/dot/reshape/... (any unhandled primitive): output joins the
+  operands' vma;
+- ``psum``/``pmax``/``pmin`` over axes A: the reduction makes the result
+  identical along A — vma := vma - A. Reducing a value already invariant
+  over an axis is a *redundant collective* (wasted bandwidth, rule 3);
+- ``all_gather``/``psum_scatter``(``reduce_scatter``)/``ppermute``/
+  ``all_to_all`` over axes A: result stays (or becomes) device-dependent —
+  vma := vma | A. This matches jax's typed semantics, where a tiled
+  all_gather output is still *typed* varying even though it is numerically
+  replicated (see parallel/zero.unscatter for why the repo psums instead
+  of gathering where an invariant type is needed);
+- ``axis_index`` over axis a: varying over {a} by construction;
+- ``pvary``/``pcast`` over axes A (post-vma jax only; the pre-vma shims
+  are identity and leave no equation behind): vma := vma | A, and casting
+  an already-varying axis is flagged (rule 4);
+- ``scan``/``while``: body interpreted to a fixpoint on the carry vmas
+  (a fresh zeros accumulator starts invariant and is joined with whatever
+  the body feeds back). A varying while-predicate joins into every carry
+  (devices may disagree on the trip count);
+- ``cond``/``switch``: outputs join across all branches AND the predicate
+  (devices taking different branches produce device-dependent results);
+- call-like primitives (pjit, remat, custom_jvp/vjp bodies): interpreted
+  through, positionally.
+
+Reported findings (``checker="vma"``):
+
+- ``missing-psum`` (error, rule 2) — a value flows into an out_spec that
+  declares it REPLICATED (no mesh axes) while the interpreter infers it
+  varying: the missing-reduction bug. Loss/metric logging, optimizer
+  scalars, and replicated parameter updates all exit through replicated
+  out_specs, so this is exactly "a varying value consumed where
+  replication is required".
+- ``vma-out-spec-mismatch`` (error, rule 1) — a SHARDED out_spec whose
+  axes disagree with the inferred vma (varying over an axis the spec does
+  not shard over): each device writes its own value into a slot the
+  program's type says is consistent — a silent cross-device race.
+- ``divergent-collective`` (error) — a collective over axis a inside a
+  cond branch / while body whose predicate varies over a: peers along a
+  disagree on whether to rendezvous (deadlock, or a mismatched exchange).
+  This machine-checks the uniform-collective contract the 1F1B pipeline
+  documents (parallel/pipeline.py).
+- ``redundant-collective`` (warn, rule 3) — psum/pmax/pmin over an axis
+  the operand is already invariant on (literal operands are exempt: the
+  ``psum(1, axis)`` axis-size idiom reduces a constant on purpose).
+- ``redundant-pvary`` (warn, rule 4) — pvary/pcast of a value already
+  varying over the requested axes.
+
+Known false-negative classes (documented in docs/ANALYSIS.md): on pre-vma
+jax the pcast/pvary shims are identity, so rule 4 only engages on post-vma
+jaxprs; ``axis_index_groups`` are treated as the full axis; primitives
+with sub-jaxprs the interpreter cannot map positionally fall back to the
+conservative join (over-approximating vma never hides a race, but the
+body's internal findings are skipped — counted in ``summary["opaque"]``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Any
+
+try:  # jax >= 0.4.16 public core surface
+    from jax.extend.core import Literal  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.core import Literal  # type: ignore
+
+from pytorch_distributed_tpu.analysis.report import Finding
+
+# Collectives that REDUCE along their axes: result is identical on every
+# member of the axis afterwards (varying -> invariant).
+_REDUCE_PRIMS = frozenset({"psum", "pmax", "pmin"})
+# Collectives whose result is (still) device-dependent along their axes.
+_VARYING_PRIMS = frozenset(
+    {"all_gather", "reduce_scatter", "ppermute", "pshuffle", "all_to_all",
+     "ragged_all_to_all"}
+)
+# vma casts (post-vma jax only; identity shims on pre-vma leave no eqn).
+_PVARY_PRIMS = frozenset({"pvary", "pcast"})
+_COLLECTIVE_PRIMS = _REDUCE_PRIMS | _VARYING_PRIMS
+# Fixpoint bound: the lattice is finite (subsets of the mesh axes) and the
+# transfer is monotone, so carries converge in <= |axes| joins per carry;
+# this is a safety net, not a tuning knob.
+_FIXPOINT_LIMIT = 16
+
+
+def _axis_names(params: dict) -> tuple[str, ...]:
+    """String mesh-axis names of a collective eqn (psum's ``axes`` may mix
+    in positional-int axes from vmap; those are not mesh axes)."""
+    raw = params.get("axes", params.get("axis_name", ()))
+    if raw is None:
+        return ()
+    if isinstance(raw, str):
+        return (raw,)
+    try:
+        return tuple(a for a in raw if isinstance(a, str))
+    except TypeError:  # a single non-str, non-iterable name object
+        return ()
+
+
+def _spec_axes(entry: Any) -> frozenset:
+    """Mesh axes named by one in_names/out_names entry.
+
+    shard_map (pre- and post-vma) carries ``{dim: (axis, ...)}`` dicts;
+    PartitionSpec entries are tolerated for forward-compatibility."""
+    if entry is None:
+        return frozenset()
+    if hasattr(entry, "items"):  # {dim: (axes...)} — the shard_map form
+        out: set = set()
+        for axes in entry.values():
+            if isinstance(axes, (tuple, list)):
+                out.update(a for a in axes if isinstance(a, str))
+            elif isinstance(axes, str):
+                out.add(axes)
+        return frozenset(out)
+    out = set()
+    for e in entry:  # PartitionSpec-like
+        if isinstance(e, str):
+            out.add(e)
+        elif isinstance(e, (tuple, list)):
+            out.update(a for a in e if isinstance(a, str))
+    return frozenset(out)
+
+
+def _sub_jaxpr(val: Any):
+    """The bare jaxpr inside a param value (ClosedJaxpr or bare), or None.
+
+    ClosedJaxpr must be unwrapped FIRST: it forwards ``.eqns`` but not
+    ``.invars``/``.outvars``, which the interpreter needs."""
+    inner = getattr(val, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    if hasattr(val, "eqns") and hasattr(val, "invars"):
+        return val
+    return None
+
+
+def _call_body(eqn) -> Any | None:
+    """For call-like primitives (pjit, remat, custom_jvp/vjp, named_call):
+    the single body jaxpr whose invars map positionally onto the eqn's."""
+    bodies = []
+    for key, val in eqn.params.items():
+        if key == "branches":
+            return None  # cond — handled structurally
+        sub = _sub_jaxpr(val)
+        if sub is not None:
+            bodies.append(sub)
+    if len(bodies) == 1 and len(bodies[0].invars) == len(eqn.invars):
+        return bodies[0]
+    return None
+
+
+@dataclasses.dataclass
+class VmaResult:
+    """Interpretation result for one shard_map body."""
+
+    findings: list[Finding]
+    out_vmas: list[frozenset]
+    opaque: Counter  # primitive name -> times conservatively joined
+
+
+class VmaInterpreter:
+    """Forward abstract interpreter for the varying-axes lattice."""
+
+    def __init__(self) -> None:
+        self.findings: list[Finding] = []
+        self.opaque: Counter = Counter()
+
+    # -- helpers ----------------------------------------------------------
+    def _finding(self, code, severity, message, **detail) -> None:
+        self.findings.append(
+            Finding(
+                checker="vma", code=code, severity=severity,
+                message=message, detail=detail,
+            )
+        )
+
+    def _check_divergence(self, eqn, axes, divergent, record) -> None:
+        clash = set(axes) & set(divergent)
+        if clash and record:
+            self._finding(
+                "divergent-collective", "error",
+                f"{eqn.primitive.name} over {sorted(clash)} executes under "
+                "control flow whose predicate varies over the same "
+                "axis/axes: peers disagree on whether to communicate "
+                "(deadlock or mismatched exchange); hoist the collective "
+                "out of the branch and gate its RESULT instead",
+                primitive=eqn.primitive.name, axes=sorted(clash),
+            )
+
+    # -- interpretation ---------------------------------------------------
+    #
+    # Each value is tracked as ``(vma, const)``: the varying-axes set plus
+    # a constant-provenance bit (derived ONLY from literals / no-input
+    # primitives like iota). The const bit exempts trace-time-constant
+    # chains from the redundant-collective rule: ``psum(1, axis)`` is the
+    # axis-size idiom, and jax 0.4's AD transposes a differentiated
+    # forward psum into ``psum(<literal cotangent seed>)`` (the pipeline
+    # loss psum) — neither is a redundancy bug a human should fix.
+
+    def interpret(
+        self,
+        jaxpr,
+        in_vmas,
+        *,
+        record: bool = True,
+        divergent: frozenset = frozenset(),
+    ) -> list[frozenset]:
+        """vmas of ``jaxpr.outvars`` given vmas of its invars."""
+        outs = self._run(
+            jaxpr, [(frozenset(s), False) for s in in_vmas],
+            record=record, divergent=divergent,
+        )
+        return [s for s, _ in outs]
+
+    def _run(self, jaxpr, ins, *, record, divergent):
+        env: dict = {}
+
+        def read(v):
+            if isinstance(v, Literal):
+                return (frozenset(), True)
+            return env.get(v, (frozenset(), False))
+
+        for v, s in zip(jaxpr.invars, ins):
+            env[v] = s
+        for v in getattr(jaxpr, "constvars", ()):
+            env[v] = (frozenset(), False)
+
+        for eqn in jaxpr.eqns:
+            eqn_ins = [read(v) for v in eqn.invars]
+            outs = self._eqn(eqn, eqn_ins, record, divergent)
+            for v, s in zip(eqn.outvars, outs):
+                env[v] = s
+        return [read(v) for v in jaxpr.outvars]
+
+    def _eqn(self, eqn, ins, record, divergent):
+        name = eqn.primitive.name
+        vmas = [s for s, _ in ins]
+        join = frozenset().union(*vmas) if vmas else frozenset()
+        all_const = all(c for _, c in ins)  # True when no inputs (iota...)
+        n_out = len(eqn.outvars)
+
+        if name in _REDUCE_PRIMS:
+            axes = frozenset(_axis_names(eqn.params))
+            self._check_divergence(eqn, axes, divergent, record)
+            outs = []
+            for v, (s, const) in zip(eqn.invars, ins):
+                dead = axes - s
+                if dead and record and not const:
+                    self._finding(
+                        "redundant-collective", "warn",
+                        f"{name} over {sorted(dead)} of a value already "
+                        "replicated on that axis/axes: every device "
+                        "contributes an identical term — the collective "
+                        "is wasted bandwidth (or the value upstream was "
+                        "MEANT to be varying)",
+                        primitive=name, axes=sorted(dead),
+                        operand=str(getattr(v, "aval", "")),
+                    )
+                outs.append((s - axes, const))
+            return outs
+
+        if name in _VARYING_PRIMS:
+            axes = frozenset(_axis_names(eqn.params))
+            self._check_divergence(eqn, axes, divergent, record)
+            outs = [(s | axes, const) for s, const in ins][:n_out]
+            return outs or [(join | axes, all_const)] * n_out
+
+        if name in _PVARY_PRIMS:
+            axes = frozenset(_axis_names(eqn.params))
+            outs = []
+            for (s, const) in ins:
+                already = axes & s
+                if already and record:
+                    self._finding(
+                        "redundant-pvary", "warn",
+                        f"{name} over {sorted(already)} of a value already "
+                        "varying on that axis/axes: the cast is a no-op "
+                        "(post-vma jax rejects it outright) — use "
+                        "ops.tp.pvary_missing to cast only missing axes",
+                        primitive=name, axes=sorted(already),
+                    )
+                outs.append((s | axes, const))
+            return outs
+
+        if name == "axis_index":
+            return [(frozenset(_axis_names(eqn.params)), False)]
+
+        if name == "scan":
+            return self._scan(eqn, ins, record, divergent)
+        if name == "while":
+            return self._while(eqn, ins, record, divergent)
+        if name == "cond":
+            return self._cond(eqn, ins, record, divergent)
+        if name == "shard_map":  # nested manual region: opaque from here
+            self.opaque[name] += 1
+            return [(join, False)] * n_out
+
+        body = _call_body(eqn)
+        if body is not None:
+            outs = self._run(body, ins, record=record, divergent=divergent)
+            if len(outs) == n_out:
+                return outs
+            self.opaque[name] += 1
+            return [(join, False)] * n_out
+
+        if any(_sub_jaxpr(v) is not None for v in eqn.params.values()):
+            # A sub-jaxpr we cannot map positionally: conservative join
+            # (may over-approximate varying; never hides a race).
+            self.opaque[name] += 1
+            return [(join, False)] * n_out
+        return [(join, all_const)] * n_out
+
+    @staticmethod
+    def _join_carry(carry, outs, extra_vma=frozenset()):
+        """Monotone carry update: vma joins UP (union), const meets DOWN
+        (and) — both directions converge."""
+        return [
+            (c | o | extra_vma, cc and oc)
+            for (c, cc), (o, oc) in zip(carry, outs)
+        ]
+
+    def _scan(self, eqn, ins, record, divergent):
+        p = eqn.params
+        nc, ncar = p["num_consts"], p["num_carry"]
+        body = _sub_jaxpr(p["jaxpr"])
+        consts, carry, xs = ins[:nc], list(ins[nc:nc + ncar]), ins[nc + ncar:]
+        for _ in range(_FIXPOINT_LIMIT):
+            outs = self._run(
+                body, consts + carry + xs, record=False, divergent=divergent
+            )
+            new = self._join_carry(carry, outs[:ncar])
+            if new == carry:
+                break
+            carry = new
+        outs = self._run(
+            body, consts + carry + xs, record=record, divergent=divergent
+        )
+        return self._join_carry(carry, outs[:ncar]) + outs[ncar:]
+
+    def _while(self, eqn, ins, record, divergent):
+        p = eqn.params
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        cond_body = _sub_jaxpr(p["cond_jaxpr"])
+        loop_body = _sub_jaxpr(p["body_jaxpr"])
+        cc, bc, carry = ins[:cn], ins[cn:cn + bn], list(ins[cn + bn:])
+        pred = frozenset()
+        for _ in range(_FIXPOINT_LIMIT):
+            pred = self._run(
+                cond_body, cc + carry, record=False, divergent=divergent
+            )[0][0]
+            outs = self._run(
+                loop_body, bc + carry, record=False,
+                divergent=divergent | pred,
+            )
+            # A varying predicate means devices disagree on the trip
+            # count, so every carry is device-dependent afterwards.
+            new = self._join_carry(carry, outs, extra_vma=pred)
+            if new == carry:
+                break
+            carry = new
+        # Both bodies are checked under the predicate's divergence: with a
+        # varying predicate devices disagree on the trip count, so a
+        # collective in the COND body (re-entered a different number of
+        # times per device) mismatches exactly like one in the loop body.
+        self._run(
+            cond_body, cc + carry, record=record, divergent=divergent | pred
+        )
+        self._run(
+            loop_body, bc + carry, record=record, divergent=divergent | pred
+        )
+        return carry
+
+    def _cond(self, eqn, ins, record, divergent):
+        (pred, pred_const), ops = ins[0], ins[1:]
+        branch_outs = []
+        for br in eqn.params["branches"]:
+            body = _sub_jaxpr(br)
+            branch_outs.append(
+                self._run(body, ops, record=record, divergent=divergent | pred)
+            )
+        return [
+            (
+                frozenset().union(pred, *(s for s, _ in per_out)),
+                pred_const and all(c for _, c in per_out),
+            )
+            for per_out in zip(*branch_outs)
+        ]
+
+
+# -------------------------------------------------------------- entry API
+
+def find_shard_map_eqns(jaxpr) -> list:
+    """Every ``shard_map`` eqn reachable from ``jaxpr`` (closed or bare),
+    recursing through sub-jaxprs but not into shard_map bodies themselves
+    (nested manual regions would need their own outer-axes context)."""
+    from pytorch_distributed_tpu.analysis.jaxpr_scan import _subjaxprs
+
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    found: list = []
+
+    def walk(jx) -> None:
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "shard_map":
+                found.append(eqn)
+                continue
+            for sub in _subjaxprs(eqn):
+                walk(sub)
+
+    walk(jaxpr)
+    return found
+
+
+def check_shard_map_eqn(eqn) -> VmaResult:
+    """Run the vma interpreter over one shard_map eqn's body and diff the
+    inferred output vmas against its out_specs."""
+    params = eqn.params
+    body = _sub_jaxpr(params["jaxpr"])
+    in_names = params.get("in_names", params.get("in_specs", ()))
+    out_names = params.get("out_names", params.get("out_specs", ()))
+    in_vmas = [_spec_axes(n) for n in in_names]
+
+    interp = VmaInterpreter()
+    out_vmas = interp.interpret(body, in_vmas, record=True)
+    findings = interp.findings
+
+    for i, (vma, names) in enumerate(zip(out_vmas, out_names)):
+        expected = _spec_axes(names)
+        extra = vma - expected
+        if not extra:
+            continue
+        aval = str(getattr(body.outvars[i], "aval", "?"))
+        if not expected:
+            findings.append(
+                Finding(
+                    checker="vma", code="missing-psum", severity="error",
+                    message=(
+                        f"output {i} ({aval}) is declared REPLICATED by its "
+                        f"out_spec but may vary over {sorted(extra)}: a "
+                        "reduction (psum/pmean) is missing upstream — each "
+                        "device would silently hold a different value "
+                        "(loss/metric/weight divergence)"
+                    ),
+                    detail={"output": i, "aval": aval,
+                            "varying": sorted(vma)},
+                )
+            )
+        else:
+            findings.append(
+                Finding(
+                    checker="vma", code="vma-out-spec-mismatch",
+                    severity="error",
+                    message=(
+                        f"output {i} ({aval}) may vary over {sorted(extra)} "
+                        f"but its out_spec only shards over "
+                        f"{sorted(expected)}: the unsharded axis/axes hold "
+                        "device-dependent values the program's type calls "
+                        "consistent — a cross-device race"
+                    ),
+                    detail={"output": i, "aval": aval,
+                            "varying": sorted(vma),
+                            "out_spec_axes": sorted(expected)},
+                )
+            )
+    return VmaResult(
+        findings=findings, out_vmas=out_vmas, opaque=interp.opaque
+    )
+
+
+def check_vma_program(jaxpr):
+    """Check every shard_map body in a traced program.
+
+    Returns ``(findings, summary)``; a program with no shard_map regions
+    is vacuously clean (the pjit path delegates replication to the SPMD
+    partitioner — noted in the summary so a report cannot silently claim
+    coverage it did not have).
+    """
+    eqns = find_shard_map_eqns(jaxpr)
+    findings: list[Finding] = []
+    opaque: Counter = Counter()
+    outputs_checked = 0
+    for eqn in eqns:
+        result = check_shard_map_eqn(eqn)
+        findings.extend(result.findings)
+        opaque.update(result.opaque)
+        outputs_checked += len(result.out_vmas)
+    summary = {
+        "shard_map_bodies": len(eqns),
+        "outputs_checked": outputs_checked,
+        "opaque": dict(opaque),
+    }
+    return findings, summary

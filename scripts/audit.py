@@ -1,14 +1,15 @@
 """Audit every registered (strategy x model) training program statically.
 
 Compiles each registered case on virtual CPU devices and runs the full
-audit pass (collective budget, donation, dtype leaks, hazards) WITHOUT
-executing a step — the pre-flight check that a sharding/optimizer edit
-didn't sneak in an extra all-gather, drop donation, or upcast the hot
-matmuls. See docs/ANALYSIS.md.
+audit pass (collective budget, donation, dtype leaks, hazards, vma
+replication check) WITHOUT executing a step — the pre-flight check that
+a sharding/optimizer edit didn't sneak in an extra all-gather, drop
+donation, upcast the hot matmuls, or lose a psum. See docs/ANALYSIS.md.
 
 Usage:
     JAX_PLATFORMS=cpu python scripts/audit.py --all
     python scripts/audit.py --case fsdp --case zero2 --json report.json
+    python scripts/audit.py --all --only vma   # compile-free, seconds
 
 Exit code: 0 when every audited program is clean, 1 otherwise.
 """
@@ -34,6 +35,10 @@ def main() -> int:
                    help="write the machine-readable report here")
     p.add_argument("--cpu-devices", type=int, default=8,
                    help="virtual CPU device count (mesh cases need 8)")
+    p.add_argument("--only", action="append", default=[],
+                   help="run only the named check(s) (repeatable; e.g. "
+                        "--only vma for the compile-free replication "
+                        "checker). Default: all checks.")
     p.add_argument("--strict", action="store_true",
                    help="warnings also fail the audit")
     p.add_argument("--allow-skips", action="store_true",
@@ -60,7 +65,13 @@ def main() -> int:
         audit_program,
         reports_to_json,
     )
+    from pytorch_distributed_tpu.analysis.audit import ALL_CHECKS
     from pytorch_distributed_tpu.analysis.registry import registered_cases
+
+    bad_checks = [c for c in args.only if c not in ALL_CHECKS]
+    if bad_checks:
+        p.error(f"unknown check(s): {bad_checks}; known: {list(ALL_CHECKS)}")
+    checks = tuple(args.only) if args.only else ALL_CHECKS
 
     cases = registered_cases()
     if args.list:
@@ -86,7 +97,9 @@ def main() -> int:
             skipped.append(name)
             continue
         fn, fn_args, budget, kwargs = case.build()
-        report = audit_program(fn, fn_args, budget, label=name, **kwargs)
+        report = audit_program(
+            fn, fn_args, budget, label=name, checks=checks, **kwargs
+        )
         reports.append(report)
         print(report.table())
         if not report.clean(allow_warnings=not args.strict):
