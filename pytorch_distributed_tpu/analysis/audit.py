@@ -33,10 +33,12 @@ import jax
 
 from pytorch_distributed_tpu.analysis.budget import (
     CollectiveBudget,
+    check_async_overlap,
     check_budget,
 )
 from pytorch_distributed_tpu.analysis.hlo import (
     aliased_param_numbers,
+    async_collective_pairs,
     collective_instructions,
 )
 from pytorch_distributed_tpu.analysis.jaxpr_scan import JaxprSummary
@@ -307,6 +309,18 @@ def audit_program(
         report.summary["collective_counts"] = {
             op: len(names) for op, names in found.items()
         }
+    if "collectives" in checks:
+        # Overlap evidence: async start/done pairs and the compute the
+        # schedule placed between them. Always recorded (budget or not);
+        # enforced when the budget carries an async_min_compute contract.
+        pairs = async_collective_pairs(hlo_text)
+        report.summary["async_collectives"] = {
+            "pairs": len(pairs),
+            "exposed": sum(1 for p in pairs if p.compute_between == 0),
+            "min_compute_between": (
+                min((p.compute_between for p in pairs), default=None)
+            ),
+        }
     if "collectives" in checks and budget is not None:
         report.extend(check_budget(found, budget, classify=classify_op))
         report.summary["budget"] = {
@@ -315,6 +329,10 @@ def audit_program(
             "max_counts": dict(budget.max_counts),
             "note": budget.note,
         }
+        if budget.async_min_compute is not None:
+            report.extend(
+                check_async_overlap(pairs, budget.async_min_compute)
+            )
 
     if "donation" in checks and expect_donation:
         try:

@@ -21,7 +21,10 @@ from __future__ import annotations
 
 import dataclasses
 
-from pytorch_distributed_tpu.analysis.hlo import HLO_COLLECTIVES
+from pytorch_distributed_tpu.analysis.hlo import (
+    HLO_COLLECTIVES,
+    AsyncCollective,
+)
 from pytorch_distributed_tpu.analysis.report import Finding
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 
@@ -32,6 +35,15 @@ class CollectiveBudget:
     forbidden: frozenset = frozenset()
     max_counts: dict = dataclasses.field(default_factory=dict)
     note: str = ""
+    # Overlap contract: when not None, every async collective
+    # start/done pair the compiled module schedules must have at least
+    # this many compute instructions between start and done
+    # (analysis/hlo.async_collective_pairs) — the machine-checkable form
+    # of "the transfer is hidden under compute, not just async-shaped".
+    # Backends that emit synchronous collectives (XLA:CPU) produce no
+    # pairs; the check then reports an info note instead of passing
+    # silently (check_async_overlap).
+    async_min_compute: int | None = None
 
     def __post_init__(self) -> None:
         object.__setattr__(self, "required", frozenset(self.required))
@@ -66,9 +78,25 @@ NO_COLLECTIVES = CollectiveBudget(
 # reduce-scatters are the gathers' AD transposes. A future edit that
 # re-gathers params twice, loses the accumulate-locally/reduce-once
 # structure, or sneaks a second grad reduction blows the ceiling.
+#
+# The latency-hiding schedule cases (PR 3):
+# - fsdp_prefetch (prefetch_buffers=1 on the 2-layer registry model =
+#   one 2-layer window): the window body textually contains W=2 copies
+#   of each per-leaf gather/scatter, so the STATIC instruction count
+#   roughly doubles while the DYNAMIC per-step collective count is
+#   unchanged (W x per-body collectives x L/W trip count). The ceiling
+#   pins that static shape — growth past it means the window gained a
+#   third gather of the same leaf or lost the re-gather structure.
+# - zero2_bucketed (rs_buckets=2): the per-leaf boundary psum_scatters
+#   coalesce into exactly rs_buckets bucket collectives — THE schedule
+#   contract; a 3rd reduce-scatter means bucketing silently broke.
 STABLE_MAX_COUNTS: dict[str, dict[str, int]] = {
     "ddp": {"all-reduce": 17},
     "fsdp": {"all-gather": 27, "reduce-scatter": 16, "all-reduce": 2},
+    "fsdp_prefetch": {
+        "all-gather": 51, "reduce-scatter": 28, "all-reduce": 2,
+    },
+    "zero2_bucketed": {"reduce-scatter": 2, "all-reduce": 18},
 }
 
 
@@ -148,6 +176,62 @@ def expected_budget(
         forbidden=frozenset(forbidden),
         note="; ".join(notes),
     )
+
+
+def check_async_overlap(
+    pairs: list[AsyncCollective],
+    min_compute: int,
+) -> list[Finding]:
+    """Assert every async collective start/done pair has compute scheduled
+    between it (the overlap contract of the prefetch schedule).
+
+    ``pairs``: analysis/hlo.async_collective_pairs over the compiled
+    module. A pair with fewer than ``min_compute`` compute instructions
+    between start and done is async in name only — the scheduler found
+    nothing to hide the transfer under, so its full latency is exposed
+    (error). An EMPTY pair list is reported as info, never success: sync
+    backends (XLA:CPU) emit no -start/-done forms at all, and a green
+    check that verified nothing would be coverage theater.
+    """
+    if not pairs:
+        return [
+            Finding(
+                checker="collectives",
+                code="no-async-collectives",
+                severity="info",
+                message=(
+                    "overlap contract requested but the compiled module "
+                    "schedules no async start/done pairs (sync-collective "
+                    "backend, e.g. XLA:CPU) — overlap is UNVERIFIED here; "
+                    "re-audit on a TPU/GPU backend for schedule evidence"
+                ),
+            )
+        ]
+    findings: list[Finding] = []
+    for pair in pairs:
+        if pair.compute_between < min_compute:
+            findings.append(
+                Finding(
+                    checker="collectives",
+                    code="exposed-async-collective",
+                    severity="error",
+                    message=(
+                        f"{pair.start!r}/{pair.done!r}: only "
+                        f"{pair.compute_between} compute instruction(s) "
+                        f"scheduled between start and done "
+                        f"(contract: >= {min_compute}) — the "
+                        f"{pair.opcode} latency is exposed, not hidden"
+                    ),
+                    detail={
+                        "opcode": pair.opcode,
+                        "start": pair.start,
+                        "done": pair.done,
+                        "compute_between": pair.compute_between,
+                        "min_compute": min_compute,
+                    },
+                )
+            )
+    return findings
 
 
 def check_budget(

@@ -70,6 +70,102 @@ def collective_counts(hlo_text: str) -> dict[str, int]:
     }
 
 
+# Opcodes that count as "compute scheduled between" an async collective's
+# start and done: post-optimization XLA keeps real math inside fusions
+# (plus the occasional unfused dot/convolution), custom-calls (Pallas
+# kernels), and nested loops. Everything else between a start/done pair —
+# tuples, bitcasts, copies, other collectives — is bookkeeping that hides
+# nothing.
+_COMPUTE_OPCODES = ("fusion", "dot", "convolution", "custom-call", "while")
+
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _first_operand(rhs_from_opcode: str) -> str | None:
+    """First operand name of ``opcode(...)``. Operand lists interleave
+    inline types with %-prefixed names (``done((f32[8], f32[64])
+    %start.1)``), so the name is the first %-token after the opcode's
+    paren; dumps without % prefixes fall back to the first bare token
+    that is not a shape (no '[')."""
+    open_idx = rhs_from_opcode.find("(")
+    if open_idx < 0:
+        return None
+    body = rhs_from_opcode[open_idx + 1:]
+    m = _OPERAND_NAME_RE.search(body)
+    if m:
+        return m.group(1)
+    for token in re.split(r"[(),\s]+", body):
+        if token and "[" not in token and "{" not in token:
+            return token
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class AsyncCollective:
+    """One async collective start/done pair in a compiled module, with the
+    number of compute instructions the schedule placed between them."""
+
+    opcode: str  # base opcode, e.g. "all-gather"
+    start: str  # instruction name of the -start
+    done: str  # instruction name of the -done
+    compute_between: int
+
+
+def async_collective_pairs(hlo_text: str) -> list[AsyncCollective]:
+    """Every ``<op>-start`` / ``<op>-done`` pair in the module, paired by
+    the done's first operand, with the count of compute instructions
+    (``_COMPUTE_OPCODES``) scheduled between them.
+
+    Post-scheduling HLO text lists each computation's instructions in
+    execution order, so "instructions between start and done" IS the work
+    the latency-hiding scheduler found to overlap with the collective:
+    ``compute_between == 0`` means the transfer is async in name only —
+    its full latency is exposed. Backends that emit synchronous
+    collectives (XLA:CPU) produce no pairs at all; callers must treat an
+    empty result as "nothing to check", not "all overlapped".
+    """
+    pending: dict[str, tuple[str, str, int]] = {}  # start name -> state
+    pairs: list[AsyncCollective] = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, rhs = m.group(1), line[m.end():]
+        matched = None
+        for op in _COLLECTIVES_LONGEST_FIRST:
+            sm = re.search(rf"\b{op}(-start|-done)?\(", rhs)
+            if sm:
+                matched = (op, sm.group(1))
+                break
+        if matched:
+            op, kind = matched
+            if kind == "-start":
+                pending[name] = (op, name, 0)
+            elif kind == "-done":
+                start_name = _first_operand(rhs[sm.start():])
+                state = pending.pop(start_name, None)
+                if state is not None:
+                    pairs.append(
+                        AsyncCollective(
+                            opcode=state[0],
+                            start=state[1],
+                            done=name,
+                            compute_between=state[2],
+                        )
+                    )
+            # A sync collective (or another collective's start/done)
+            # between a pair does not count as compute.
+            continue
+        is_compute = any(
+            re.search(rf"\b{op}\(", rhs) for op in _COMPUTE_OPCODES
+        )
+        if is_compute and pending:
+            pending = {
+                k: (op, s, n + 1) for k, (op, s, n) in pending.items()
+            }
+    return pairs
+
+
 @dataclasses.dataclass(frozen=True)
 class AliasEntry:
     """One input->output buffer alias from the HLO module header."""

@@ -93,6 +93,7 @@ def _build_explicit(
     mcfg: MeshConfig,
     n_experts: int = 0,
     budget_case: str | None = None,
+    async_min_compute: int | None = None,
     **model_overrides,
 ):
     from pytorch_distributed_tpu.models import get_model
@@ -117,6 +118,13 @@ def _build_explicit(
     budget = expected_budget(mcfg, cfg)
     if budget_case is not None:
         budget = pin_max_counts(budget, budget_case)
+    if async_min_compute is not None:
+        # Overlap contract: on async-collective backends (TPU/GPU) every
+        # start/done pair must bracket compute; sync backends record an
+        # info note (budget.check_async_overlap).
+        budget = dataclasses.replace(
+            budget, async_min_compute=async_min_compute
+        )
     audit_kwargs = {"compute_dtype": cfg.dtype}
     if cfg.dtype == "bfloat16":
         # The bf16 contract: ZERO all-f32 matmuls. The f32-OUT dots the
@@ -229,6 +237,31 @@ def registered_cases() -> dict[str, AuditCase]:
             8,
             lambda: _build_explicit(
                 MeshConfig(fsdp=8, strategy="shard_grad_op")
+            ),
+        ),
+        AuditCase(
+            "fsdp_prefetch",
+            "explicit ZeRO-3 + latency-hiding window: fsdp=8, "
+            "prefetch_buffers=1 (max_counts pinned, overlap contract)",
+            8,
+            lambda: _build_explicit(
+                MeshConfig(
+                    fsdp=8, strategy="full_shard", prefetch_buffers=1
+                ),
+                budget_case="fsdp_prefetch",
+                async_min_compute=1,
+            ),
+        ),
+        AuditCase(
+            "zero2_bucketed",
+            "explicit ZeRO-2 + bucketed reduce-scatter: fsdp=8, "
+            "rs_buckets=2 (max_counts pinned)",
+            8,
+            lambda: _build_explicit(
+                MeshConfig(
+                    fsdp=8, strategy="shard_grad_op", rs_buckets=2
+                ),
+                budget_case="zero2_bucketed",
             ),
         ),
         AuditCase(

@@ -374,6 +374,29 @@ class MeshConfig:
     # at pipe slots instead of the microbatch count; parallel/pipeline.py).
     pipe_schedule: str = "gpipe"
 
+    # Latency-hiding schedule knobs for the explicit (shard_map) path
+    # (parallel/explicit.py; ops/layer_scan.py):
+    #
+    # prefetch_buffers (ZeRO-3/full_shard only): how many EXTRA layers'
+    # params may be in flight beyond the one being computed. 0 = the
+    # just-in-time schedule (gather layer l inside layer l's scan body —
+    # compute stalls on every gather). N > 0 restructures the layer scan
+    # into windows of N+1 layers whose all_gathers are all issued before
+    # the window's first block runs, so layer l+1's gather overlaps layer
+    # l's compute (and the rematted backward re-gathers a whole window up
+    # front the same way, letting the AD-transposed reduce-scatters
+    # interleave with the remaining backward compute). SOFT hint: the
+    # effective window is the largest divisor of n_layer <= N+1. Costs
+    # N extra layers' worth of live gathered params in HBM.
+    prefetch_buffers: int = 0
+    # rs_buckets (ZeRO-2/shard_grad_op only): when > 0, the boundary
+    # per-leaf gradient psum_scatters are coalesced into ~rs_buckets
+    # bucketed collectives (flattened + concatenated per dtype/vma group,
+    # parallel/zero.scatter_grads_bucketed) — fewer, larger transfers
+    # that amortise per-collective latency and let XLA pipeline buckets
+    # against each other. 0 = per-leaf scatters (the teaching layout).
+    rs_buckets: int = 0
+
     axis_order: tuple[str, ...] = (
         "pipe", "data", "fsdp", "expert", "seq", "tensor"
     )
@@ -387,6 +410,14 @@ class MeshConfig:
             raise ValueError(
                 f"unknown pipe_schedule: {self.pipe_schedule!r} "
                 "(implemented: gpipe, 1f1b)"
+            )
+        if self.prefetch_buffers < 0:
+            raise ValueError(
+                f"prefetch_buffers must be >= 0, got {self.prefetch_buffers}"
+            )
+        if self.rs_buckets < 0:
+            raise ValueError(
+                f"rs_buckets must be >= 0, got {self.rs_buckets}"
             )
 
     @property

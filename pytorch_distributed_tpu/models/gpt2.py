@@ -45,8 +45,9 @@ import jax.numpy as jnp
 
 from pytorch_distributed_tpu.config import ModelConfig
 from pytorch_distributed_tpu.ops.attention import multi_head_attention
+from pytorch_distributed_tpu.ops.layer_scan import scan_layers
 from pytorch_distributed_tpu.ops.layers import activation, dense, dropout, layer_norm
-from pytorch_distributed_tpu.ops.remat import apply_remat, checkpoint_name
+from pytorch_distributed_tpu.ops.remat import checkpoint_name
 from pytorch_distributed_tpu.ops.tp import tp_copy
 from pytorch_distributed_tpu.utils.compat import vma_of
 
@@ -250,6 +251,7 @@ def apply(
     expert_axis: str | None = None,
     return_aux: bool = False,
     return_hidden: bool = False,
+    prefetch_buffers: int = 0,
 ) -> jax.Array:
     """Forward pass: [B, T] token ids -> [B, T, V] float32 logits.
     With ``return_aux=True`` returns (logits, moe_aux_loss) — the summed
@@ -266,6 +268,11 @@ def apply(
     before use inside the scan — the hook explicit FSDP uses for just-in-time
     per-layer all_gather (parallel/explicit.py); remat then re-gathers in
     backward, matching FSDP's free-after-use behavior.
+
+    ``prefetch_buffers``: latency-hiding window for the block_transform
+    gathers — layer l+1..l+N's transforms are issued before layer l's
+    compute (ops/layer_scan.py). Bit-equivalent to the default
+    just-in-time schedule; soft-sized to a divisor of n_layer.
 
     ``seq_axis``: set when called inside shard_map with the sequence dim
     sharded over that mesh axis (context parallelism): positions are offset
@@ -302,13 +309,11 @@ def apply(
         dropout_key, k_embd = jax.random.split(dropout_key)
         x = dropout(x, cfg.embd_pdrop, k_embd, deterministic=False)
 
-    # Scan over stacked block params; remat each block body. The per-layer
-    # dropout key is folded from (dropout_key, layer_index) inside the scan.
-    def scan_body(carry, xs):
+    # Scan over stacked block params; remat each block (or prefetch
+    # window) body — ops/layer_scan.py. The per-layer dropout key is
+    # folded from (dropout_key, layer_index) inside the scan.
+    def block_body(carry, bp, layer_idx):
         h, aux_sum = carry
-        bp, layer_idx = xs
-        if block_transform is not None:
-            bp = block_transform(bp)
         layer_key = (
             None
             if deterministic
@@ -318,9 +323,8 @@ def apply(
             h, bp, cfg, layer_key, deterministic, seq_axis, tensor_axis,
             expert_axis,
         )
-        return (h, aux_sum + aux), None
+        return (h, aux_sum + aux)
 
-    body = apply_remat(scan_body, cfg.remat)
     layer_ids = jnp.arange(cfg.n_layer)
     # The aux carry must vary on every axis the activations vary on (any
     # sharded batch/param axis under shard_map), not just the expert axis —
@@ -331,8 +335,11 @@ def apply(
         jnp.zeros((), jnp.float32),
         tuple(vma_of(x)),
     )
-    (x, aux_total), _ = jax.lax.scan(
-        body, (x, aux0), (params["blocks"], layer_ids),
+    x, aux_total = scan_layers(
+        block_body, (x, aux0), params["blocks"], layer_ids,
+        remat_mode=cfg.remat,
+        block_transform=block_transform,
+        prefetch_buffers=prefetch_buffers,
         unroll=cfg.scan_unroll,
     )
     if return_hidden:
@@ -382,6 +389,7 @@ def run_blocks(
     expert_axis: str | None = None, seq_axis: str | None = None,
     dropout_key: jax.Array | None = None,
     deterministic: bool = True, layer_offset=0,
+    prefetch_buffers: int = 0,
 ):
     """Scan a stack of [L_local, ...] block params over x (L_local may be a
     pipeline stage's slice of the full depth). With ``return_aux=True``
@@ -413,11 +421,8 @@ def run_blocks(
     if not deterministic and dropout_key is None:
         raise ValueError("training-mode run_blocks requires dropout_key")
 
-    def body(carry, xs):
+    def block_body(carry, bp, layer_idx):
         h, aux_sum = carry
-        bp, layer_idx = xs
-        if block_transform is not None:
-            bp = block_transform(bp)
         layer_key = (
             None
             if deterministic
@@ -427,16 +432,18 @@ def run_blocks(
             h, bp, cfg, layer_key, deterministic, seq_axis, tensor_axis,
             expert_axis,
         )
-        return (h, aux_sum + aux), None
+        return (h, aux_sum + aux)
 
     aux0 = pvary_missing(
         jnp.zeros((), jnp.float32),
         tuple(vma_of(x)),
     )
     n_local = jax.tree.leaves(blocks)[0].shape[0]
-    (x, aux_total), _ = jax.lax.scan(
-        apply_remat(body, cfg.remat), (x, aux0),
-        (blocks, jnp.arange(n_local)),
+    x, aux_total = scan_layers(
+        block_body, (x, aux0), blocks, jnp.arange(n_local),
+        remat_mode=cfg.remat,
+        block_transform=block_transform,
+        prefetch_buffers=prefetch_buffers,
     )
     if return_aux:
         return x, aux_total

@@ -315,6 +315,17 @@ def from_hf_llama_state_dict(sd: dict, cfg: ModelConfig) -> dict:
                 f"router stacked shape {got_r} != {expect_r} — config "
                 "n_experts mismatch with the checkpoint"
             )
+        # Expert FFN shapes too: a cfg.n_inner mismatch with the
+        # checkpoint's intermediate_size would import cleanly here and
+        # only surface later as an opaque matmul shape error in apply().
+        expect_e = (cfg.n_layer, cfg.n_experts, cfg.n_embd, cfg.inner_dim)
+        for ours in ("w_gate", "w_in"):
+            got_e = params["blocks"]["mlp"][ours].shape
+            if got_e != expect_e:
+                raise ValueError(
+                    f"{ours} stacked shape {got_e} != {expect_e} — config "
+                    "n_inner/intermediate_size mismatch with the checkpoint"
+                )
 
     got = params["blocks"]["attn"]["wk"].shape
     expect = (cfg.n_layer, cfg.n_embd, cfg.kv_heads * cfg.head_dim)

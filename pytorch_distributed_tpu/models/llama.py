@@ -29,8 +29,9 @@ import jax.numpy as jnp
 from pytorch_distributed_tpu.config import ModelConfig
 from pytorch_distributed_tpu.models.gpt2 import _flash_kernel_active
 from pytorch_distributed_tpu.ops.attention import multi_head_attention
+from pytorch_distributed_tpu.ops.layer_scan import scan_layers
 from pytorch_distributed_tpu.ops.layers import rms_norm
-from pytorch_distributed_tpu.ops.remat import apply_remat, checkpoint_name
+from pytorch_distributed_tpu.ops.remat import checkpoint_name
 from pytorch_distributed_tpu.ops.rope import apply_rope, rope_angles
 from pytorch_distributed_tpu.ops.tp import tp_copy, tp_reduce
 from pytorch_distributed_tpu.utils.compat import vma_of
@@ -159,6 +160,7 @@ def apply(
     expert_axis: str | None = None,
     return_aux: bool = False,
     return_hidden: bool = False,
+    prefetch_buffers: int = 0,
 ) -> jax.Array:
     """[B, T] int tokens -> [B, T, V] float32 logits. The llama family is
     dropout-free (cfg presets zero the pdrop fields), so train and eval
@@ -186,16 +188,13 @@ def apply(
     )
     cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta, offset=offset)
 
-    def scan_body(carry, bp):
+    def block_body(carry, bp, _extra):
         h, aux_sum = carry
-        if block_transform is not None:
-            bp = block_transform(bp)
         h, aux = _block(
             h, bp, cfg, cos, sin, seq_axis, tensor_axis, expert_axis
         )
-        return (h, aux_sum + aux), None
+        return (h, aux_sum + aux)
 
-    body = apply_remat(scan_body, cfg.remat)
     # The aux carry must match the activations' varying axes under
     # shard_map (see models/gpt2.py).
     from pytorch_distributed_tpu.ops.tp import pvary_missing
@@ -204,8 +203,12 @@ def apply(
         jnp.zeros((), jnp.float32),
         tuple(vma_of(x)),
     )
-    (x, aux_total), _ = jax.lax.scan(
-        body, (x, aux0), params["blocks"], unroll=cfg.scan_unroll
+    x, aux_total = scan_layers(
+        block_body, (x, aux0), params["blocks"],
+        remat_mode=cfg.remat,
+        block_transform=block_transform,
+        prefetch_buffers=prefetch_buffers,
+        unroll=cfg.scan_unroll,
     )
     if return_hidden:
         # Final-norm hidden states for the fused head+CE loss (see
@@ -246,6 +249,7 @@ def run_blocks(
     expert_axis: str | None = None, seq_axis: str | None = None,
     dropout_key: jax.Array | None = None,
     deterministic: bool = True, layer_offset=0,
+    prefetch_buffers: int = 0,
 ):
     """See models/gpt2.py run_blocks — with ``return_aux=True`` returns
     (x, aux), the local layers' summed Switch load-balancing term;
@@ -264,21 +268,22 @@ def run_blocks(
     )
     cos, sin = rope_angles(t, cfg.head_dim, cfg.rope_theta, offset=offset)
 
-    def body(carry, bp):
+    def block_body(carry, bp, _extra):
         h, aux_sum = carry
-        if block_transform is not None:
-            bp = block_transform(bp)
         h, aux = _block(
             h, bp, cfg, cos, sin, seq_axis, tensor_axis, expert_axis
         )
-        return (h, aux_sum + aux), None
+        return (h, aux_sum + aux)
 
     aux0 = pvary_missing(
         jnp.zeros((), jnp.float32),
         tuple(vma_of(x)),
     )
-    (x, aux_total), _ = jax.lax.scan(
-        apply_remat(body, cfg.remat), (x, aux0), blocks
+    x, aux_total = scan_layers(
+        block_body, (x, aux0), blocks,
+        remat_mode=cfg.remat,
+        block_transform=block_transform,
+        prefetch_buffers=prefetch_buffers,
     )
     if return_aux:
         return x, aux_total

@@ -24,6 +24,8 @@ count too (repeat_kv first if it does not — the caller's choice).
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 
 def _heads_to_seq(x: jax.Array, axis_name: str) -> jax.Array:
@@ -87,6 +89,17 @@ def ulysses_attention(
     # single-device math (GQA group structure is preserved: H/n query
     # heads over Hkv/n KV heads keeps the same group size).
     dropout_active = not deterministic and dropout_rate > 0.0
+    if impl == "flash" and dropout_active:
+        # Loud, not silent: at the sequence lengths Ulysses exists for,
+        # the O(T^2) score matrix this fallback materialises can OOM or
+        # regress sharply with no other runtime signal.
+        warnings.warn(
+            "ulysses_attention: impl='flash' with active attention "
+            "dropout falls back to NAIVE attention (flash has no dropout "
+            f"support) — O(T^2) score memory at T={q.shape[1] * n} "
+            "global sequence length; set attn_pdrop=0.0 to keep flash",
+            stacklevel=2,
+        )
     if dropout_active and dropout_key is not None:
         dropout_key = jax.random.fold_in(
             dropout_key, jax.lax.axis_index(axis_name)

@@ -78,6 +78,7 @@ from pytorch_distributed_tpu.parallel.zero import (
     clip_by_global_norm_typed,
     gather_params as _gather_params,
     scatter_grads as _scatter_grads,
+    scatter_grads_bucketed as _scatter_grads_bucketed,
     spec_has as _spec_has,
     zero_sharded_update,
 )
@@ -201,8 +202,14 @@ def make_explicit_train_step(
         def gather_block(bp):
             return _gather_params(bp, block_specs)
 
+        # Latency-hiding schedule: prefetch the next N layers' gathers
+        # ahead of the current layer's compute (ops/layer_scan.py).
+        # Bit-equivalent to the just-in-time schedule — only the issue
+        # order of the (deterministic) all_gathers changes.
+        prefetch_buffers = mesh_cfg.prefetch_buffers
     else:
         gather_block = None
+        prefetch_buffers = 0
 
     def forward_loss(params_shard, inputs, targets, key):
         if train_mode:
@@ -236,6 +243,7 @@ def make_explicit_train_step(
             expert_axis=expert_axis,
             return_aux=bool(model_cfg.n_experts),
             return_hidden=fused,
+            prefetch_buffers=prefetch_buffers,
         )
         out, aux = out if model_cfg.n_experts else (out, 0.0)
         if fused:
@@ -345,7 +353,15 @@ def make_explicit_train_step(
                 grads = jax.lax.pmean(grads, "data")
         elif strategy == "shard_grad_op" and fsdp_size > 1:
             # ZeRO-2: reduce_scatter to shards (+ mean over data axis).
-            grads = _scatter_grads(grads, shard_specs, fsdp_size)
+            # rs_buckets > 0 coalesces the per-leaf scatters into bucketed
+            # collectives (parallel/zero.py) — numerically identical, and
+            # the downstream sharded update consumes the same layout.
+            if mesh_cfg.rs_buckets > 0:
+                grads = _scatter_grads_bucketed(
+                    grads, shard_specs, fsdp_size, mesh_cfg.rs_buckets
+                )
+            else:
+                grads = _scatter_grads(grads, shard_specs, fsdp_size)
             grads = jax.tree.map(lambda g: g / fsdp_size, grads)
             if "data" in dp_axes and mesh_cfg.data > 1:
                 grads = jax.lax.pmean(grads, "data")

@@ -66,6 +66,79 @@ def scatter_grads(grads, specs, fsdp_size: int):
     return jax.tree.map(scatter, grads, specs)
 
 
+def scatter_grads_bucketed(grads, specs, fsdp_size: int, n_buckets: int):
+    """``scatter_grads`` with the per-leaf psum_scatters coalesced into
+    ~``n_buckets`` bucketed collectives (reference: the DDP C++ reducer's
+    gradient bucketing, here applied to ZeRO-2's boundary reduce-scatter).
+
+    Each fsdp-sharded leaf is rearranged so its fsdp dim leads, reshaped
+    to [fsdp_size, -1], and concatenated with its bucket-mates; ONE
+    psum_scatter per bucket then reduces+splits the whole bucket, and the
+    shards are sliced back out. Fewer, larger transfers amortise the
+    per-collective latency and give XLA's scheduler independent buckets
+    to pipeline. Numerically identical to ``scatter_grads``: the same
+    elementwise sums over the same chunk of each leaf, just transported
+    together (equivalence pinned in tests/test_prefetch.py).
+
+    Buckets are formed within (dtype, vma) groups — mixed-dtype grads
+    (bf16 accumulation) and mixed-vma leaves (tensor-sharded vs
+    replicated under TP x ZeRO-2) cannot share a concatenation. Leaves
+    with no fsdp dim keep their plain psum, exactly like
+    ``scatter_grads``."""
+    from pytorch_distributed_tpu.utils.compat import vma_of
+
+    leaves, treedef = jax.tree.flatten(grads)
+    spec_leaves = jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P))
+    dims = [axis_dim(spec, "fsdp") for spec in spec_leaves]
+    out: list = [None] * len(leaves)
+
+    groups: dict[tuple, list[int]] = {}
+    for i, (leaf, dim) in enumerate(zip(leaves, dims)):
+        if dim is None:
+            out[i] = jax.lax.psum(leaf, "fsdp")
+        else:
+            key = (str(leaf.dtype), tuple(sorted(vma_of(leaf))))
+            groups.setdefault(key, []).append(i)
+
+    for idxs in groups.values():
+        total = sum(leaves[i].size for i in idxs)
+        target = -(-total // max(1, n_buckets))  # ceil
+        buckets: list[list[int]] = [[]]
+        filled = 0
+        for i in idxs:
+            if filled >= target and buckets[-1]:
+                buckets.append([])
+                filled = 0
+            buckets[-1].append(i)
+            filled += leaves[i].size
+        for bucket in buckets:
+            parts, metas = [], []
+            for i in bucket:
+                g, dim = leaves[i], dims[i]
+                moved = jnp.moveaxis(g, dim, 0)
+                parts.append(moved.reshape(fsdp_size, -1))
+                metas.append((i, moved.shape, dim))
+            flat = (
+                parts[0]
+                if len(parts) == 1
+                else jnp.concatenate(parts, axis=1)
+            )
+            scattered = jax.lax.psum_scatter(
+                flat, "fsdp", scatter_dimension=0, tiled=True
+            )  # [1, total/fsdp_size]: this shard's chunk of the bucket sum
+            off = 0
+            for i, moved_shape, dim in metas:
+                width = leaves[i].size // fsdp_size
+                shard_shape = (
+                    moved_shape[0] // fsdp_size,
+                ) + moved_shape[1:]
+                piece = scattered[:, off:off + width].reshape(shard_shape)
+                out[i] = jnp.moveaxis(piece, 0, dim)
+                off += width
+
+    return jax.tree.unflatten(treedef, out)
+
+
 def shard_slice(full, spec: P, fsdp_size: int):
     """Take this device's fsdp slice of a replicated array (ZeRO-2/1
     update)."""

@@ -148,6 +148,126 @@ def project_step(
     }
 
 
+def project_step_overlap(
+    *,
+    comm_bytes: float,
+    compute_ms: float,
+    overlap_fraction: float,
+    chip: ChipSpec = V5E,
+) -> dict:
+    """Overlap-aware step projection: split collective time into HIDDEN
+    (under compute) vs EXPOSED (serialising the step) at a given achieved
+    overlap fraction, instead of bracketing none..full like
+    ``project_step``.
+
+    ``overlap_fraction`` is the fraction of total collective time hidden
+    under compute — the same quantity ``trace_analysis.comm_comp_overlap``
+    measures (overlap_pct / 100), so a measured trace number plugs in
+    directly. Hidden time is additionally capped by the compute time
+    itself: no schedule can hide more communication than there is compute
+    to hide it under. step = compute + exposed, per ici band.
+    """
+    if not 0.0 <= overlap_fraction <= 1.0:
+        raise ValueError(
+            f"overlap_fraction must be in [0, 1], got {overlap_fraction}"
+        )
+
+    def split(comm_ms: float) -> tuple[float, float]:
+        hidden = min(comm_ms * overlap_fraction, compute_ms)
+        return hidden, comm_ms - hidden
+
+    comm_fast_ms = comm_bytes / chip.ici_eff_high * 1e3
+    comm_slow_ms = comm_bytes / chip.ici_eff_low * 1e3
+    hid_fast, exp_fast = split(comm_fast_ms)
+    hid_slow, exp_slow = split(comm_slow_ms)
+    return {
+        "overlap_fraction": overlap_fraction,
+        "comm_ms_band": (comm_fast_ms, comm_slow_ms),
+        "hidden_ms_band": (hid_fast, hid_slow),
+        "exposed_ms_band": (exp_fast, exp_slow),
+        "step_ms_band": (
+            compute_ms + exp_fast,
+            compute_ms + exp_slow,
+        ),
+    }
+
+
+def project_fsdp_prefetch_mfu(
+    *,
+    n_params: int,
+    n_layer: int,
+    n_chips: int,
+    measured_ms_per_step: float,
+    measured_mfu_pct: float,
+    prefetch_buffers: int = 1,
+    param_bytes: int = 2,
+    grad_bytes: int | None = None,
+    chip: ChipSpec = V5E,
+) -> dict:
+    """``project_fsdp_mfu`` for the double-buffered prefetch schedule
+    (parallel/explicit.py prefetch_buffers): instead of bracketing
+    overlap none..full, model what the schedule can actually hide.
+
+    Pipeline accounting, assuming per-layer uniform traffic/compute:
+
+    - startup: the first window's W = prefetch_buffers + 1 layer gathers
+      run before any compute exists to hide them — always exposed;
+    - drain: the first layer's backward reduce-scatter completes after
+      the last backward compute — always exposed;
+    - steady state: the remaining traffic hides under compute up to the
+      compute time itself (comm-bound meshes still expose the excess).
+
+    exposed = startup + drain + max(0, comm_rest - compute). The band
+    comes from the ici bracket, like every projection here."""
+    traffic = fsdp_comm_bytes_per_step(
+        n_params, n_chips, param_bytes=param_bytes, grad_bytes=grad_bytes
+    )
+    window = min(max(1, prefetch_buffers + 1), max(1, n_layer))
+
+    def project(ici_bytes_per_s: float) -> tuple[float, float]:
+        comm_ms = traffic["total"] / ici_bytes_per_s * 1e3
+        ag_layer_ms = (
+            traffic["all_gather"] / ici_bytes_per_s * 1e3 / (2 * n_layer)
+        )
+        rs_layer_ms = (
+            traffic["reduce_scatter"] / ici_bytes_per_s * 1e3 / n_layer
+        )
+        startup = window * ag_layer_ms
+        drain = rs_layer_ms
+        rest = max(0.0, comm_ms - startup - drain)
+        exposed = startup + drain + max(
+            0.0, rest - measured_ms_per_step
+        )
+        return exposed, comm_ms
+
+    exp_fast, comm_fast = project(chip.ici_eff_high)
+    exp_slow, comm_slow = project(chip.ici_eff_low)
+    best_ms = measured_ms_per_step + exp_fast
+    worst_ms = measured_ms_per_step + exp_slow
+    return {
+        "chip": chip.name,
+        "n_chips": n_chips,
+        "prefetch_buffers": prefetch_buffers,
+        "comm_bytes_per_step": traffic,
+        "comm_ms_band": (comm_fast, comm_slow),
+        "exposed_ms_band": (exp_fast, exp_slow),
+        "hidden_ms_band": (comm_fast - exp_fast, comm_slow - exp_slow),
+        "step_ms_band": (best_ms, worst_ms),
+        "mfu_pct_band": (
+            measured_mfu_pct * measured_ms_per_step / worst_ms,
+            measured_mfu_pct * measured_ms_per_step / best_ms,
+        ),
+        "assumptions": (
+            f"{chip.name} public specs; ici_eff "
+            f"{chip.ici_eff_low/1e9:.0f}-{chip.ici_eff_high/1e9:.0f} GB/s "
+            "per chip; uniform per-layer traffic; prefetch hides steady-"
+            "state gathers/scatters under compute, exposing only the "
+            f"{window}-layer startup gather + 1-layer drain scatter "
+            "(+ any comm-bound excess); weak scaling"
+        ),
+    }
+
+
 def project_fsdp_mfu(
     *,
     n_params: int,

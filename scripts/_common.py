@@ -114,11 +114,18 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
 def setup_platform(args) -> None:
     """MUST run before any jax import."""
     if args.cpu_devices:
-        flags = os.environ.get("XLA_FLAGS", "")
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count="
-            f"{args.cpu_devices}"
-        ).strip()
+        # Strip any stale device-count flag first: re-entrant calls (or a
+        # flag inherited from the environment) must not leave two counts
+        # for XLA to pick between.
+        flags = [
+            f
+            for f in os.environ.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ]
+        flags.append(
+            f"--xla_force_host_platform_device_count={args.cpu_devices}"
+        )
+        os.environ["XLA_FLAGS"] = " ".join(flags)
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,239 @@
+"""Multichip throughput benchmark over the explicit shard_map legs.
+
+Promotes the driver's 16-leg correctness dryrun (__graft_entry__.py) into
+a THROUGHPUT measurement: for each data-parallel leg it builds the real
+explicit train step on an N-device mesh, times full optimizer steps, and
+captures a jax.profiler trace whose comm/compute interval algebra
+(profiling/trace_analysis.py — the HTA analogues) yields the overlap
+fraction: how much of the leg's collective time the schedule hid under
+compute vs exposed on the critical path.
+
+Legs (all on one mesh size, same global batch):
+
+  ddp              data=N,  no_shard        one boundary grad all-reduce
+  zero1            fsdp=N,  shard_opt       all-reduce + sharded Adam
+  zero2            fsdp=N,  shard_grad_op   per-leaf boundary reduce-scatter
+  zero2_bucketed   + rs_buckets             bucketed reduce-scatter
+  zero3            fsdp=N,  full_shard      just-in-time layer gathers
+  zero3_prefetch   + prefetch_buffers       windowed double-buffered gathers
+
+On the CPU rig (virtual devices, default) the tok/s numbers measure the
+schedule's structure, not real ICI — collectives are memcpys — so treat
+them as A/B-comparable within one run only; overlap_pct is real schedule
+evidence either way (the intervals come from the compiler's own emitted
+collectives). On a real multi-chip mesh pass --real.
+
+Usage:
+  python scripts/bench_multichip.py                       # 8 virtual devices
+  python scripts/bench_multichip.py --legs zero3,zero3_prefetch --steps 8
+  python scripts/bench_multichip.py --json benchmarks/multichip_bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import setup_platform  # noqa: E402  (bootstraps the repo root)
+
+LEGS = {
+    # name -> MeshConfig kwargs (devices filled in at runtime)
+    "ddp": dict(strategy="no_shard", axis="data"),
+    "zero1": dict(strategy="shard_opt", axis="fsdp"),
+    "zero2": dict(strategy="shard_grad_op", axis="fsdp"),
+    "zero2_bucketed": dict(strategy="shard_grad_op", axis="fsdp",
+                           rs_buckets=2),
+    "zero3": dict(strategy="full_shard", axis="fsdp"),
+    "zero3_prefetch": dict(strategy="full_shard", axis="fsdp",
+                           prefetch_buffers=1),
+}
+
+
+def bench_leg(name: str, n_devices: int, args) -> dict:
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.config import (
+        MeshConfig, ModelConfig, TrainConfig,
+    )
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.parallel import make_mesh, shard_train_state
+    from pytorch_distributed_tpu.parallel.explicit import (
+        make_explicit_train_step,
+    )
+    from pytorch_distributed_tpu.parallel.mesh import make_batch_put
+    from pytorch_distributed_tpu.profiling.trace_analysis import (
+        comm_comp_overlap,
+        load_trace,
+        temporal_breakdown,
+    )
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    spec = dict(LEGS[name])
+    axis = spec.pop("axis")
+    mcfg = MeshConfig(**{axis: n_devices}, **spec)
+
+    cfg = ModelConfig(
+        vocab_size=256, n_ctx=args.seq_len, n_embd=args.n_embd,
+        n_layer=args.n_layer, n_head=4, dtype="float32",
+        embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+    )
+    rows = args.rows * n_devices  # global micro-batch rows
+    tcfg = TrainConfig(
+        global_batch_size=args.accum * rows,
+        micro_batch_size=args.rows,
+        num_steps=args.steps,
+        learning_rate=1e-3,
+    )
+    model = get_model(cfg)
+    tx = make_optimizer(tcfg)
+    mesh = make_mesh(mcfg)
+    state = init_train_state(model.init(domain_key(0, "init"), cfg), tx)
+    state, _ = shard_train_state(state, mesh, mcfg)
+    step = make_explicit_train_step(model, cfg, tx, mesh, mcfg, state)
+    put = make_batch_put(mesh, mcfg)
+
+    # Fresh random batches per step (relay/caching hygiene — BENCH
+    # methodology): seed from urandom so deterministic-repeat caches
+    # cannot serve the timed steps.
+    rng = np.random.default_rng(int.from_bytes(os.urandom(4), "little"))
+
+    def fresh_batch():
+        return put({
+            "inputs": rng.integers(
+                0, 256, (args.accum, rows, args.seq_len)
+            ).astype(np.int32),
+            "targets": rng.integers(
+                0, 256, (args.accum, rows, args.seq_len)
+            ).astype(np.int32),
+        })
+
+    key = jax.random.key(1)
+    for _ in range(max(1, args.warmup)):  # compile + warm
+        state, metrics = step(state, fresh_batch(), key)
+        float(jax.device_get(metrics["loss"]))
+
+    # Timed window: dispatch -> device_get of the scalar loss fences
+    # every step.
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, metrics = step(state, fresh_batch(), key)
+        loss = float(jax.device_get(metrics["loss"]))
+    elapsed = time.perf_counter() - t0
+    tokens = args.steps * args.accum * rows * args.seq_len
+
+    # Overlap capture: a short profiled window, analysed with the same
+    # interval machinery the HTA-analogue tests pin
+    # (tests/test_trace_collectives.py).
+    overlap, breakdown = {}, {}
+    if not args.no_trace:
+        with tempfile.TemporaryDirectory() as trace_dir:
+            with jax.profiler.trace(trace_dir):
+                for _ in range(args.trace_steps):
+                    state, metrics = step(state, fresh_batch(), key)
+                jax.block_until_ready(metrics["loss"])
+            files = glob.glob(
+                f"{trace_dir}/**/*.trace.json.gz", recursive=True
+            )
+            if files:
+                trace = load_trace(files[0])
+                overlap = comm_comp_overlap(trace)
+                breakdown = temporal_breakdown(trace)
+
+    return {
+        "leg": name,
+        "mesh": {k: v for k, v in mcfg.shape.items() if v > 1},
+        "strategy": mcfg.strategy,
+        "prefetch_buffers": mcfg.prefetch_buffers,
+        "rs_buckets": mcfg.rs_buckets,
+        "n_devices": n_devices,
+        "tokens_per_sec": round(tokens / elapsed, 1),
+        "step_ms": round(elapsed / args.steps * 1e3, 2),
+        "loss": round(loss, 4),
+        "overlap_pct": round(overlap.get("overlap_pct", 0.0), 2),
+        "comm_exposed_pct": round(
+            breakdown.get("communication_exposed_pct", 0.0), 2
+        ),
+        "communication_pct": round(
+            breakdown.get("communication_pct", 0.0), 2
+        ),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--legs", default="ddp,zero1,zero2,zero2_bucketed,"
+                                      "zero3,zero3_prefetch",
+                    help="comma-separated subset of: " + ",".join(LEGS))
+    ap.add_argument("--devices", type=int, default=8,
+                    help="mesh size (virtual CPU devices unless --real)")
+    ap.add_argument("--real", action="store_true",
+                    help="use the ambient platform's real devices instead "
+                         "of forcing a virtual CPU mesh")
+    ap.add_argument("--rows", type=int, default=2,
+                    help="per-device micro-batch rows")
+    ap.add_argument("--accum", type=int, default=2,
+                    help="grad-accumulation micro-steps per optimizer step")
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--n-layer", type=int, default=4)
+    ap.add_argument("--n-embd", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=8,
+                    help="timed optimizer steps per leg")
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--trace-steps", type=int, default=3,
+                    help="profiled steps for the overlap capture")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the profiler capture (tok/s only)")
+    ap.add_argument("--json", default=None,
+                    help="also write all rows as a JSON array here")
+    args = ap.parse_args()
+
+    legs = [s.strip() for s in args.legs.split(",") if s.strip()]
+    unknown = [s for s in legs if s not in LEGS]
+    if unknown:
+        ap.error(f"unknown leg(s) {unknown}; known: {list(LEGS)}")
+    if args.steps < 1 or args.warmup < 0 or args.trace_steps < 1:
+        ap.error("--steps/--trace-steps must be >= 1, --warmup >= 0")
+
+    # Self-provision a virtual CPU mesh BEFORE jax initialises (shared
+    # _common.setup_platform: strips any stale device-count flag, and pins
+    # cpu via jax.config — the site hook re-forces the TPU platform, so
+    # the env var alone is not enough). --real leaves the ambient
+    # platform untouched (cpu_devices=0 is a no-op).
+    setup_platform(
+        argparse.Namespace(
+            cpu_devices=0 if args.real else args.devices
+        )
+    )
+    import jax
+
+    if len(jax.devices()) < args.devices:
+        raise SystemExit(
+            f"need {args.devices} devices, have {len(jax.devices())} "
+            "(drop --real or lower --devices)"
+        )
+
+    rows = []
+    for leg in legs:
+        res = bench_leg(leg, args.devices, args)
+        rows.append(res)
+        print(json.dumps(res))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.json}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -144,20 +144,24 @@ def bench_speculative(preset: str, prompt_len: int, max_new: int,
 
     warm = fresh_prompt()
     run_plain(warm), run_spec(warm)  # compile both programs
-    ratios, plain_ts, spec_ts, matched = [], [], [], 0
+    plain_ts, spec_ts, matched = [], [], 0
     for _ in range(repeats):
         p = fresh_prompt()
         out_p, tp_ = run_plain(p)
         out_s, ts_ = run_spec(p)
         plain_ts.append(tp_)
         spec_ts.append(ts_)
-        ratios.append(tp_ / ts_)
         # Exactness check where the numbers are measured. bf16 runs may
         # legitimately diverge at near-tied logits (the 1-token and
         # K+1-token programs round differently — models/speculative.py
         # module docstring), so this is REPORTED, not asserted.
         matched += int(np.array_equal(out_p, out_s))
-    med = sorted(ratios)[len(ratios) // 2]
+    # One pair of medians feeds all three derived fields, so the JSON row
+    # is internally consistent: speedup == plain_tok/s ÷ spec_tok/s
+    # exactly (a median of per-run ratios can disagree with the ratio of
+    # median times within a single row).
+    med_plain = float(np.median(plain_ts))
+    med_spec = float(np.median(spec_ts))
     return dict(
         preset=preset,
         mode="speculative",
@@ -166,9 +170,9 @@ def bench_speculative(preset: str, prompt_len: int, max_new: int,
         draft_len=draft_len,
         ngram=ngram,
         max_new=max_new,
-        plain_tokens_per_sec=round(max_new / np.median(plain_ts), 1),
-        speculative_tokens_per_sec=round(max_new / np.median(spec_ts), 1),
-        speedup=round(med, 3),
+        plain_tokens_per_sec=round(max_new / med_plain, 1),
+        speculative_tokens_per_sec=round(max_new / med_spec, 1),
+        speedup=round(med_plain / med_spec, 3),
         outputs_match=f"{matched}/{repeats}",
         platform=jax.devices()[0].platform,
     )

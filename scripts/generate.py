@@ -95,6 +95,14 @@ def main() -> int:
             "--speculative is greedy-only (temperature sampling needs "
             "rejection-sampling corrections); drop --temperature"
         )
+    if args.speculative and (args.top_k is not None or args.top_p is not None):
+        # Same contract as the temperature check: silently ignoring the
+        # sampling flags would print greedy output a user believes is
+        # top-k/nucleus sampled.
+        raise SystemExit(
+            "--speculative is greedy-only; --top-k/--top-p would be "
+            "silently ignored — drop them"
+        )
 
     # Validate --mesh BEFORE any weight IO (an HF pull or checkpoint
     # restore can be multi-GB; a typo'd axis should not cost that).

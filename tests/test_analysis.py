@@ -796,3 +796,115 @@ def test_ddp_compiled_counts_meet_the_pinned_budget(eight_devices):
     assert report.clean(), report.table()
     found = report.summary["collective_counts"]
     assert found["all-reduce"] <= budget.max_counts["all-reduce"]
+
+
+# ------------------------------------- async overlap contract (PR 3)
+
+_HLO_ASYNC = """\
+HloModule jit_step, is_scheduled=true
+ENTRY main {
+  %p0 = f32[8]{0} parameter(0)
+  %ag-start.1 = (f32[8]{0}, f32[64]{0}) all-gather-start(f32[8]{0} %p0), dimensions={0}
+  %fusion.1 = f32[8]{0} fusion(f32[8]{0} %p0), kind=kLoop
+  %dot.2 = f32[8]{0} dot(f32[8]{0} %fusion.1, f32[8]{0} %fusion.1)
+  %ag-done.1 = f32[64]{0} all-gather-done((f32[8]{0}, f32[64]{0}) %ag-start.1)
+  %rs-start.9 = f32[8]{0} reduce-scatter-start(f32[64]{0} %ag-done.1)
+  %bitcast.3 = f32[8]{0} bitcast(f32[8]{0} %dot.2)
+  %rs-done.9 = f32[8]{0} reduce-scatter-done(f32[8]{0} %rs-start.9)
+  %ar-start.4 = f32[8]{0} all-reduce-start(f32[8]{0} %p0)
+  %ar-done.4 = f32[8]{0} all-reduce-done(f32[8]{0} %ar-start.4)
+}
+"""
+
+
+def test_async_collective_pairs_parse_and_count_compute():
+    """Pairs matched by the done's start operand; compute counted between
+    them (fusion/dot yes, bitcast and other collectives no)."""
+    from pytorch_distributed_tpu.analysis.hlo import async_collective_pairs
+
+    pairs = {p.start: p for p in async_collective_pairs(_HLO_ASYNC)}
+    assert set(pairs) == {"ag-start.1", "rs-start.9", "ar-start.4"}
+    ag = pairs["ag-start.1"]
+    assert (ag.opcode, ag.done, ag.compute_between) == (
+        "all-gather", "ag-done.1", 2
+    )
+    # Only the bitcast sits between rs start/done: zero compute.
+    assert pairs["rs-start.9"].compute_between == 0
+    assert pairs["rs-start.9"].opcode == "reduce-scatter"
+    assert pairs["ar-start.4"].compute_between == 0
+
+
+def test_async_pairs_absent_on_sync_hlo():
+    from pytorch_distributed_tpu.analysis.hlo import async_collective_pairs
+
+    assert async_collective_pairs(_HLO_SAMPLE[:0]) == []
+    # The plain (sync) sample has a dangling -start with no -done: no pair.
+    assert async_collective_pairs(_HLO_SAMPLE) == []
+
+
+def test_check_async_overlap_contract():
+    """A pair with no compute between start and done is an exposed
+    transfer (error); an empty pair list reports info, never silent
+    success (sync backends verify nothing)."""
+    from pytorch_distributed_tpu.analysis.budget import check_async_overlap
+    from pytorch_distributed_tpu.analysis.hlo import async_collective_pairs
+
+    findings = check_async_overlap(async_collective_pairs(_HLO_ASYNC), 1)
+    assert sorted(f.code for f in findings) == [
+        "exposed-async-collective", "exposed-async-collective",
+    ]
+    assert all(f.severity == "error" for f in findings)
+    assert any("rs-start.9" in f.message for f in findings)
+
+    empty = check_async_overlap([], 1)
+    assert [f.code for f in empty] == ["no-async-collectives"]
+    assert empty[0].severity == "info"
+
+
+def test_audit_records_async_summary_and_enforces_contract():
+    """audit_program under a budget with async_min_compute: the summary
+    always records pair counts; on this rig's sync-collective backend the
+    contract degrades to the info note and the audit stays clean."""
+    import dataclasses
+
+    from pytorch_distributed_tpu.analysis.budget import CollectiveBudget
+
+    mesh_budget = dataclasses.replace(
+        CollectiveBudget(required=frozenset(), forbidden=frozenset()),
+        async_min_compute=1,
+    )
+
+    def f(x):
+        return x * 2
+
+    report = audit_program(
+        jax.jit(f), (jnp.ones(4),), mesh_budget,
+        expect_donation=False, checks=("collectives",),
+        label="async-summary",
+    )
+    assert report.summary["async_collectives"]["pairs"] == 0
+    assert report.clean(allow_warnings=False), report.table()
+
+
+def test_stable_max_counts_pinned_for_schedule_cases(eight_devices):
+    """The latency-hiding registry cases carry their measured ceilings:
+    fsdp_prefetch's window statically duplicates the per-leaf gathers
+    (dynamic per-step count unchanged), zero2_bucketed coalesces the 16
+    per-leaf reduce-scatters into exactly rs_buckets=2 instructions —
+    plus the overlap contract on the prefetch case."""
+    from pytorch_distributed_tpu.analysis.budget import STABLE_MAX_COUNTS
+    from pytorch_distributed_tpu.analysis.registry import registered_cases
+
+    cases = registered_cases()
+    for name in ("fsdp_prefetch", "zero2_bucketed"):
+        _, _, budget, _ = cases[name].build()
+        assert budget.max_counts == STABLE_MAX_COUNTS[name], name
+    assert STABLE_MAX_COUNTS["zero2_bucketed"]["reduce-scatter"] == 2
+    assert (
+        STABLE_MAX_COUNTS["fsdp_prefetch"]["all-gather"]
+        > STABLE_MAX_COUNTS["fsdp"]["all-gather"]
+    )
+    _, _, pf_budget, _ = cases["fsdp_prefetch"].build()
+    assert pf_budget.async_min_compute == 1
+    _, _, z2_budget, _ = cases["zero2_bucketed"].build()
+    assert z2_budget.async_min_compute is None

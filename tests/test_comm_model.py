@@ -244,3 +244,122 @@ def test_mfu_band_ordering_tracks_step_band():
         assert 0 < lo <= hi <= 50.0
         assert lo == pytest.approx(50.0 * 100.0 / worst_ms)
         assert hi == pytest.approx(50.0 * 100.0 / best_ms)
+
+
+# ----------------------------------------------- overlap-aware projection
+
+
+def test_project_step_overlap_limits_match_project_step():
+    """f=0 reproduces project_step's no-overlap worst case; f=1 with
+    compute >= comm reproduces the full-overlap best case."""
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        project_step_overlap,
+    )
+
+    none = project_step_overlap(
+        comm_bytes=1e9, compute_ms=50.0, overlap_fraction=0.0, chip=V5E
+    )
+    ref = project_step(comm_bytes=1e9, compute_ms=50.0, chip=V5E)
+    assert none["exposed_ms_band"] == pytest.approx(ref["comm_ms_band"])
+    assert none["step_ms_band"][1] == pytest.approx(ref["step_ms_band"][1])
+    assert none["hidden_ms_band"] == (0.0, 0.0)
+
+    full = project_step_overlap(
+        comm_bytes=1e9, compute_ms=50.0, overlap_fraction=1.0, chip=V5E
+    )
+    # comm_slow = 1e9/45e9*1e3 ~ 22 ms < 50 ms compute: fully hidden.
+    assert full["exposed_ms_band"] == (0.0, pytest.approx(0.0))
+    assert full["step_ms_band"] == (50.0, pytest.approx(50.0))
+
+
+def test_project_step_overlap_hidden_capped_by_compute():
+    """No schedule hides more comm than there is compute to hide it
+    under: with comm >> compute, hidden saturates at compute_ms and the
+    excess stays exposed even at f=1."""
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        project_step_overlap,
+    )
+
+    proj = project_step_overlap(
+        comm_bytes=1e10, compute_ms=5.0, overlap_fraction=1.0, chip=V5E
+    )
+    for hidden, (comm, exposed) in zip(
+        proj["hidden_ms_band"],
+        zip(proj["comm_ms_band"], proj["exposed_ms_band"]),
+    ):
+        assert hidden == pytest.approx(5.0)
+        assert exposed == pytest.approx(comm - 5.0)
+
+
+def test_project_step_overlap_monotone_in_fraction():
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        project_step_overlap,
+    )
+
+    prev = float("inf")
+    for f in (0.0, 0.25, 0.5, 0.75, 1.0):
+        worst = project_step_overlap(
+            comm_bytes=1e9, compute_ms=50.0, overlap_fraction=f, chip=V5E
+        )["step_ms_band"][1]
+        assert worst <= prev
+        prev = worst
+
+
+def test_project_step_overlap_rejects_bad_fraction():
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        project_step_overlap,
+    )
+
+    for f in (-0.1, 1.5):
+        with pytest.raises(ValueError, match="overlap_fraction"):
+            project_step_overlap(
+                comm_bytes=1e9, compute_ms=10.0, overlap_fraction=f
+            )
+
+
+def test_project_fsdp_prefetch_exposes_only_startup_and_drain():
+    """Compute-dominated regime: the prefetch pipeline hides everything
+    except the first window's gathers and the last reduce-scatter."""
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        fsdp_comm_bytes_per_step,
+        project_fsdp_prefetch_mfu,
+    )
+
+    n_params, n_layer, n_chips = 10**9, 16, 8
+    proj = project_fsdp_prefetch_mfu(
+        n_params=n_params, n_layer=n_layer, n_chips=n_chips,
+        measured_ms_per_step=1000.0,  # plenty of compute to hide under
+        measured_mfu_pct=50.0, prefetch_buffers=1,
+    )
+    traffic = fsdp_comm_bytes_per_step(n_params, n_chips)
+    for exposed, ici in zip(
+        proj["exposed_ms_band"], (V5E.ici_eff_high, V5E.ici_eff_low)
+    ):
+        ag_layer = traffic["all_gather"] / ici * 1e3 / (2 * n_layer)
+        rs_layer = traffic["reduce_scatter"] / ici * 1e3 / n_layer
+        assert exposed == pytest.approx(2 * ag_layer + rs_layer)
+    # And the projection always beats (or ties) the no-overlap worst case
+    # while never beating the compute floor.
+    best, worst = proj["step_ms_band"]
+    assert 1000.0 <= best <= worst
+    assert worst <= 1000.0 + proj["comm_ms_band"][1] + 1e-9
+
+
+def test_project_fsdp_prefetch_comm_bound_still_pays_excess():
+    """Comm-bound regime: steady-state traffic beyond the compute time
+    stays exposed — prefetch is latency hiding, not bandwidth creation."""
+    from pytorch_distributed_tpu.profiling.comm_model import (
+        project_fsdp_prefetch_mfu,
+    )
+
+    proj = project_fsdp_prefetch_mfu(
+        n_params=10**10, n_layer=16, n_chips=64,
+        measured_ms_per_step=1.0, measured_mfu_pct=50.0,
+        prefetch_buffers=1,
+    )
+    comm_fast, comm_slow = proj["comm_ms_band"]
+    exp_fast, exp_slow = proj["exposed_ms_band"]
+    # Nearly all comm is exposed (only compute_ms=1 of steady state
+    # hides), and the step can never be faster than the comm itself.
+    assert exp_slow == pytest.approx(comm_slow - 1.0)
+    assert proj["step_ms_band"][1] == pytest.approx(comm_slow)

@@ -305,6 +305,22 @@ def test_mixtral_import_mismatched_experts_rejected(hf_mixtral_and_cfg):
         ))
 
 
+def test_mixtral_import_mismatched_inner_dim_rejected(hf_mixtral_and_cfg):
+    """cfg.n_inner disagreeing with the checkpoint's intermediate_size
+    must fail AT IMPORT with a shape diagnostic naming the expert leaf —
+    not later as an opaque matmul shape error inside apply()
+    (ADVICE r5; same diagnostic style as the router/wk checks)."""
+    from pytorch_distributed_tpu.models.hf_import import (
+        from_hf_llama_state_dict,
+    )
+
+    model, cfg = hf_mixtral_and_cfg
+    with pytest.raises(ValueError, match="w_gate stacked shape"):
+        from_hf_llama_state_dict(
+            model.state_dict(), cfg.replace(n_inner=128)
+        )
+
+
 @pytest.mark.parametrize("which", ["llama", "mixtral"])
 def test_llama_export_inverts_import(hf_llama_and_cfg, hf_mixtral_and_cfg, which):
     """to_hf_llama_state_dict is the exact inverse of the importer:

@@ -91,3 +91,44 @@ def test_speculative_rejects_bad_args():
     # max_new_tokens=0: the prompt is the output.
     out = generate_speculative(params, prompt, cfg, 0)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+# -- CLI contract: scripts/generate.py --speculative is greedy-only ---------
+
+
+def _generate_main(argv, monkeypatch):
+    import importlib.util
+    import sys
+    from pathlib import Path
+
+    scripts = Path(__file__).resolve().parent.parent / "scripts"
+    monkeypatch.syspath_prepend(str(scripts))
+    spec = importlib.util.spec_from_file_location(
+        "_generate_cli", scripts / "generate.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(sys, "argv", ["generate.py"] + argv)
+    return mod.main()
+
+
+@pytest.mark.parametrize(
+    "flags,match",
+    [
+        (["--temperature", "0.8"], "greedy-only"),
+        (["--top-k", "40"], "top-k"),
+        (["--top-p", "0.9"], "top-p"),
+        (["--mesh", "tensor=2"], "single-device"),
+    ],
+)
+def test_generate_cli_speculative_rejects_sampling_flags(
+    flags, match, monkeypatch
+):
+    """--speculative with ANY sampling/mesh flag must SystemExit up front
+    (ADVICE r5: --top-k/--top-p were silently ignored — a user believed
+    top-k sampling applied to plain greedy output). Fails before any
+    weight IO or jax work."""
+    with pytest.raises(SystemExit, match=match):
+        _generate_main(
+            ["--preset", "tiny", "--speculative", "4"] + flags, monkeypatch
+        )

@@ -172,6 +172,50 @@ def test_ulysses_flash_backend_matches_naive(seq_mesh):
     )
 
 
+def test_ulysses_flash_dropout_fallback_warns(seq_mesh):
+    """impl='flash' with active attention dropout silently ran O(T^2)
+    naive attention (flash has no dropout support) — the fallback still
+    happens, but now with a loud warnings.warn naming the memory cost
+    (ADVICE r5). The warning fires at trace time, once."""
+    q, k, v = _qkv(seed=13)
+    spec = P(None, "seq", None, None)
+
+    def local(qs, ks, vs, key):
+        return ulysses_attention(
+            qs, ks, vs, axis_name="seq", causal=True, impl="flash",
+            dropout_rate=0.3, dropout_key=key, deterministic=False,
+        )
+
+    fn = jax.jit(
+        shard_map(
+            local, mesh=seq_mesh,
+            in_specs=(spec, spec, spec, P()),
+            out_specs=spec,
+        )
+    )
+    with pytest.warns(UserWarning, match="falls back to NAIVE"):
+        out = fn(q, k, v, jax.random.key(0))
+    assert np.isfinite(np.asarray(out)).all()
+    # The deterministic flash path stays warning-free.
+    import warnings as _warnings
+
+    det = jax.jit(
+        shard_map(
+            functools.partial(
+                ulysses_attention, axis_name="seq", causal=True,
+                impl="flash",
+            ),
+            mesh=seq_mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )
+    )
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        det(q, k, v)
+    assert not [w for w in rec if "falls back" in str(w.message)]
+
+
 # -- attention dropout under ulysses (round-5: was a blanket seq refusal) --
 
 
