@@ -76,6 +76,7 @@ def check_donation(
     donate_argnums: tuple[int, ...],
     *,
     memory_analysis=None,
+    strict: bool = False,
 ) -> tuple[list[Finding], dict]:
     """Verify the donated arguments survived compilation as buffer aliases.
 
@@ -86,6 +87,13 @@ def check_donation(
     partial run means XLA rejected some aliases (shape/dtype mismatch
     between the donated input and any output — the "donated buffer was not
     usable" warning made machine-checkable).
+
+    ``strict``: a PARTIAL alias set is an error, not a warn. Training
+    steps tolerate the odd rejected leaf (a reshaped optimizer slot is a
+    wart, not a contract breach); for programs whose donation IS the
+    perf contract — the serving engine's in-place KV cache — any
+    non-aliased donated buffer silently double-buffers the largest
+    tensor in the program and must fail the audit.
     """
     aliased = aliased_param_numbers(hlo_text)
     expected: set[int] = set()
@@ -128,7 +136,7 @@ def check_donation(
             Finding(
                 checker="donation",
                 code="donation-rejected",
-                severity="warn",
+                severity="error" if strict else "warn",
                 message=(
                     f"XLA rejected {len(missing)} of {len(expected)} "
                     "donated-state aliases (those buffers are "
@@ -264,6 +272,7 @@ def audit_program(
     label: str | None = None,
     donate_argnums: tuple[int, ...] = (0,),
     expect_donation: bool = True,
+    donation_strict: bool = False,
     compute_dtype: str | None = None,
     allowed_f32_dots: int = 0,
     checks: tuple[str, ...] = ALL_CHECKS,
@@ -280,7 +289,8 @@ def audit_program(
     None skips the budget diff but still records collective counts.
     ``compute_dtype``: the activation dtype the program is configured for
     (ModelConfig.dtype); dtype checks only engage for low-precision
-    programs.
+    programs. ``donation_strict``: partial donation aliasing is an error
+    (see check_donation — the serving-engine cache contract).
     ``vma_allow``: {finding code: reason} — downgrade the named vma
     findings to info with the reason attached (the audit-level analogue of
     a repolint allow-comment: the decision stays visible in the report).
@@ -340,7 +350,8 @@ def audit_program(
         except Exception:  # backend without the C API
             ma = None
         findings, stats = check_donation(
-            hlo_text, args, donate_argnums, memory_analysis=ma
+            hlo_text, args, donate_argnums, memory_analysis=ma,
+            strict=donation_strict,
         )
         report.extend(findings)
         report.summary["donation"] = stats

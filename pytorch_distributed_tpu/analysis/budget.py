@@ -90,6 +90,13 @@ NO_COLLECTIVES = CollectiveBudget(
 # - zero2_bucketed (rs_buckets=2): the per-leaf boundary psum_scatters
 #   coalesce into exactly rs_buckets bucket collectives — THE schedule
 #   contract; a 3rd reduce-scatter means bucketing silently broke.
+# - zero3_decode_prefetch (the serving engine's ZeRO-3 decode_run,
+#   prefetch_buffers=1 on the 2-layer registry model = one 2-layer
+#   window): the partitioner's per-leaf layer gathers appear W=2 times
+#   in the window body plus the up-front non-block gathers; growth past
+#   the ceiling means a layer's shards started gathering twice per use
+#   (or the embedding/head gathers moved inside the token loop). The
+#   all-reduces are the partitioner's logit/softmax reductions.
 STABLE_MAX_COUNTS: dict[str, dict[str, int]] = {
     "ddp": {"all-reduce": 17},
     "fsdp": {"all-gather": 27, "reduce-scatter": 16, "all-reduce": 2},
@@ -97,6 +104,7 @@ STABLE_MAX_COUNTS: dict[str, dict[str, int]] = {
         "all-gather": 51, "reduce-scatter": 28, "all-reduce": 2,
     },
     "zero2_bucketed": {"reduce-scatter": 2, "all-reduce": 18},
+    "zero3_decode_prefetch": {"all-gather": 28, "all-reduce": 11},
 }
 
 

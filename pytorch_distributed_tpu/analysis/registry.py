@@ -134,6 +134,46 @@ def _build_explicit(
     return step, args, budget, audit_kwargs
 
 
+def _build_decode_engine(
+    kind: str,
+    mesh_cfg: MeshConfig | None = None,
+    budget: CollectiveBudget | None = NO_COLLECTIVES,
+    budget_case: str | None = None,
+    async_min_compute: int | None = None,
+):
+    """A serving-engine decode program (serving/engine.py): the EXACT
+    jitted prefill / decode_step / decode_run the engine dispatches, with
+    the KV cache donated at its real argnum — audited with
+    ``donation_strict`` because in-place cache reuse IS the serving
+    contract (a rejected alias double-buffers the largest tensor in the
+    server on every step)."""
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.serving.engine import (
+        BucketSpec,
+        DecodeEngine,
+    )
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _tiny()
+    params = get_model(cfg).init(domain_key(42, "init"), cfg)
+    engine = DecodeEngine(
+        cfg, max_len=16, buckets=BucketSpec((8, 16)), mesh_cfg=mesh_cfg
+    )
+    fn = engine.program(kind, sampled=True)
+    args = engine.example_args(kind, params, batch=1, sampled=True)
+    if budget_case is not None:
+        budget = pin_max_counts(budget, budget_case)
+    if async_min_compute is not None:
+        budget = dataclasses.replace(
+            budget, async_min_compute=async_min_compute
+        )
+    return fn, args, budget, {
+        "compute_dtype": cfg.dtype,
+        "donate_argnums": (engine.CACHE_ARGNUM[kind],),
+        "donation_strict": True,
+    }
+
+
 def _build_pipeline(schedule: str):
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.parallel import make_mesh
@@ -308,6 +348,44 @@ def registered_cases() -> dict[str, AuditCase]:
             "1F1B (PipeDream-flush) pipeline: pipe=2, hand-scheduled",
             2,
             _build_pipeline_1f1b,
+        ),
+        # Serving-engine decode programs (serving/engine.py): donation of
+        # the KV cache is the contract under audit (strict aliasing), on
+        # top of the collective budgets.
+        AuditCase(
+            "decode_prefill",
+            "serving engine prefill (donated bucketed KV cache, traced "
+            "sampling): single device, any collective is a bug",
+            1,
+            lambda: _build_decode_engine("prefill"),
+        ),
+        AuditCase(
+            "decode_step",
+            "serving engine single decode step (donated KV cache): "
+            "single device, any collective is a bug",
+            1,
+            lambda: _build_decode_engine("decode_step"),
+        ),
+        AuditCase(
+            "zero3_decode_prefetch",
+            "serving engine ZeRO-3 decode_run: fsdp=8, full_shard, "
+            "prefetch_buffers=1 windowed layer gathers (max_counts "
+            "pinned, overlap contract)",
+            8,
+            lambda: _build_decode_engine(
+                "decode_run",
+                mesh_cfg=MeshConfig(
+                    fsdp=8, strategy="full_shard", prefetch_buffers=1
+                ),
+                budget=CollectiveBudget(
+                    required={"all-gather"},
+                    note="ZeRO-3 decode must gather each layer's shards "
+                         "(a window at a time); other resharding is the "
+                         "partitioner's choice",
+                ),
+                budget_case="zero3_decode_prefetch",
+                async_min_compute=1,
+            ),
         ),
         # pjit twins of the explicit cases (parallel/api.py). Budgets per
         # _build_pjit's docstring: derived where the partitioner's op set

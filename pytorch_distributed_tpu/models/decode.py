@@ -2,13 +2,20 @@
 
 The reference repo has no inference path at all — training only. A complete
 framework needs one: this module adds prefill + single-token decode over a
-preallocated KV cache, and a jit-compiled ``generate`` loop (greedy or
-temperature sampling), for gpt2 and llama params produced by
+preallocated KV cache, and the ``generate`` / ``generate_tp`` /
+``generate_fsdp`` entry points for gpt2 and llama params produced by
 ``models.get_model(cfg)`` — dense AND MoE variants (routing is per-token
-and cache-free, see ``_moe_mlp``). ``generate_tp`` runs the same loop
-tensor-parallel over a "tensor" mesh: Megatron-sharded params, local-head
-attention against a local-head cache shard (1/tp of the cache HBM), one
-psum per row-parallel projection.
+and cache-free, see ``_moe_mlp``).
+
+Since the serving PR, the public ``generate*`` entry points are thin compat
+shims over ``serving.engine.DecodeEngine`` — the two-program
+(prefill / decode-step) serving fast path with a DONATED, pooled KV cache,
+bucketed prompt compilation, and traced sampling scalars. The original
+one-jit monolithic programs survive as ``generate_monolithic`` /
+``generate_tp_monolithic`` / ``generate_fsdp_monolithic``: the reference
+implementations the engine is pinned bit-equal against
+(tests/test_serving.py), and the "per-call path" leg of
+scripts/decode_bench.py.
 
 Design (TPU-first):
 - The cache is a pytree of stacked per-layer tensors ``k/v [L, B, S, Hkv, D]``
@@ -17,13 +24,18 @@ Design (TPU-first):
   length) and decode (T = 1) with one code path: new keys/values are
   ``dynamic_update_slice``d into the cache at ``pos`` and attention masks
   key positions ``> pos + i`` (padding beyond the write point is masked
-  out, so stale cache contents are never read).
-- Layers run under the same ``lax.scan``-over-stacked-params structure as
-  training; the per-layer cache slices ride the scan's xs/ys.
+  out, so stale cache contents are never read — the invariant that makes
+  both prompt bucketing and dirty-buffer cache donation sound).
+- Layers run under the shared ``ops/layer_scan.scan_layers`` scan-over-
+  stacked-params (``collect_ys=True`` carries the per-layer cache slices),
+  so the windowed double-buffer prefetch schedule training uses applies to
+  ZeRO-3 decode as well (``block_transform`` + ``prefetch_buffers``).
 - Attention here is the naive einsum path in f32: decode is matmul-light
   ([B, H, T, S] with T = 1), so flash-kernel dispatch is pointless.
-- The generate loop is a ``lax.fori_loop`` over steps inside one jit; the
-  output buffer is preallocated [B, prompt + max_new] and updated in place.
+- Sampling params (``temperature``/``top_k``/``top_p``) are TRACED runtime
+  scalars on every path — a serving loop changing sampling configs never
+  recompiles; only greedy-vs-sampled is a static bool (temperature 0 needs
+  a different program shape: no division, no sort, no key).
 
 No dropout (inference), no remat (nothing to save).
 """
@@ -38,6 +50,7 @@ import jax
 import jax.numpy as jnp
 
 from pytorch_distributed_tpu.config import ModelConfig
+from pytorch_distributed_tpu.ops.layer_scan import scan_layers
 from pytorch_distributed_tpu.ops.layers import (
     activation,
     dense,
@@ -163,6 +176,8 @@ def forward(
     pos: jax.Array | int,  # tokens already in the cache
     *,
     tensor_axis: str | None = None,
+    block_transform=None,
+    prefetch_buffers: int = 0,
 ) -> tuple[jax.Array, Cache]:
     """Run T tokens at positions pos..pos+T-1. Returns ([B, T, V] logits,
     updated cache). MoE configs route each token through the expert MLPs
@@ -173,6 +188,13 @@ def forward(
     sharded Megatron-style (tensor-parallel decode): attention runs on
     the LOCAL heads against a local-head cache shard, row-parallel
     projections psum over the axis, and the logits come back replicated.
+
+    ``block_transform`` / ``prefetch_buffers``: the scan-over-layers hooks
+    (ops/layer_scan.py) — ZeRO-3 decode passes a gather/replicate
+    transform per layer, and with ``prefetch_buffers`` > 0 a whole
+    window's gathers are issued before its first block computes, so layer
+    l+1's shards stream in under layer l's compute (serving/engine.py).
+    Bit-equivalent to the default per-layer schedule for any window size.
     """
     b, t = input_ids.shape
     dtype = jnp.dtype(cfg.dtype)
@@ -194,13 +216,20 @@ def forward(
     else:
         raise KeyError(f"unknown model family {cfg.family!r}")
 
-    def scan_body(x, xs):
-        bp, ck_l, cv_l = xs
+    def block_body(x, bp, extra):
+        ck_l, cv_l = extra
         x, ck_l, cv_l = block(x, bp, ck_l, cv_l, pos)
         return x, (ck_l, cv_l)
 
-    x, (ck, cv) = jax.lax.scan(
-        scan_body, x, (params["blocks"], cache["k"], cache["v"])
+    x, (ck, cv) = scan_layers(
+        block_body,
+        x,
+        params["blocks"],
+        extras=(cache["k"], cache["v"]),
+        remat_mode="none",
+        block_transform=block_transform,
+        prefetch_buffers=prefetch_buffers,
+        collect_ys=True,
     )
 
     from pytorch_distributed_tpu.models import get_model
@@ -209,38 +238,88 @@ def forward(
     return logits, {"k": ck, "v": cv}
 
 
-def _sample(logits, temperature, key, top_k=None, top_p=None):
-    """[B, V] -> [B] next tokens. temperature 0 = greedy; top_k restricts
-    sampling to the k highest-probability tokens; top_p (nucleus) restricts
-    it to the smallest set whose probability mass reaches p. Given BOTH,
-    top-k applies first and the nucleus is taken within it (HF semantics).
+# -- sampling --------------------------------------------------------------
+#
+# Greedy-vs-sampled is the ONE static bit (a greedy program has no
+# division, no vocab sort, no PRNG); everything else about the sampling
+# config is a traced scalar, so a serving loop sweeping temperature /
+# top_k / top_p reuses one compiled program. ``None`` top_k / top_p are
+# encoded as out-of-range sentinels (k = vocab size keeps the full
+# support; p = 2.0 keeps every cumulative mass) rather than separate
+# static program variants.
+
+
+def sampling_scalars(
+    temperature, top_k, top_p, vocab_size: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Encode the (possibly-None) Python sampling config as the traced
+    scalar triple every sampled program takes. Explicit dtypes — a
+    weak-typed Python scalar would retrace when its Python type changes
+    (the exact hazard analysis/jaxpr_scan flags). ``top_k`` in
+    {None, 0} means top-k disabled (full support — the HF convention for
+    0; a traced k=0 would otherwise mask EVERY token and silently
+    degrade to greedy); negative k is rejected here, where the Python
+    int is still visible."""
+    if top_k is not None and top_k < 0:
+        raise ValueError(f"top_k must be >= 0 or None, got {top_k}")
+    t = jnp.asarray(temperature if temperature else 1.0, jnp.float32)
+    k = jnp.asarray(top_k or vocab_size, jnp.int32)
+    p = jnp.asarray(2.0 if top_p is None else top_p, jnp.float32)
+    return t, k, p
+
+
+def _sample_greedy(logits):
+    """[B, V] -> [B] argmax tokens (the static greedy program)."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _sample_traced(logits, temperature, key, top_k, top_p):
+    """[B, V] -> [B] next tokens with TRACED temperature/top_k/top_p
+    (see ``sampling_scalars`` for the None-sentinels). top_k restricts
+    sampling to the k highest-probability tokens; top_p (nucleus)
+    restricts it to the smallest set whose probability mass reaches p.
+    Given BOTH, top-k applies first and the nucleus is taken within it
+    (HF semantics: the renormalised mass is over the top-k support).
+
+    Mechanics: one full-vocab descending sort per step (``lax.top_k`` at
+    k = V — the price of a traced k; HF's sampler pays the same sort for
+    top_p), then rank/cumulative-mass masks. The argmax token always
+    survives both filters, so top_k=1 or top_p->0 reduce to greedy.
     """
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
-    if top_k is None and top_p is None:
-        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
-    k = top_k if top_k is not None else logits.shape[-1]
-    vals, idx = jax.lax.top_k(logits, k)  # [B, k], sorted desc
-    if top_p is not None:
-        # Keep tokens whose CUMULATIVE mass (within the top-k support)
-        # before them is < p — the argmax token always survives.
-        probs = jax.nn.softmax(vals, axis=-1)
-        cum_before = jnp.cumsum(probs, axis=-1) - probs
-        vals = jnp.where(cum_before < top_p, vals, -jnp.inf)
+    v = logits.shape[-1]
+    vals, idx = jax.lax.top_k(logits, v)  # [B, V], sorted desc
+    rank = jax.lax.broadcasted_iota(jnp.int32, vals.shape, 1)
+    in_k = rank < top_k
+    probs = jax.nn.softmax(jnp.where(in_k, vals, -jnp.inf), axis=-1)
+    # Keep tokens whose CUMULATIVE mass (within the top-k support)
+    # before them is < p — the argmax token always survives.
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    vals = jnp.where(in_k & (cum_before < top_p), vals, -jnp.inf)
     choice = jax.random.categorical(key, vals, axis=-1)  # [B]
     return jnp.take_along_axis(
         idx, choice[:, None], axis=-1
     )[:, 0].astype(jnp.int32)
 
 
+def sample_token(logits, sampled: bool, temperature, key, top_k, top_p):
+    """One next-token draw: ``sampled`` is the static greedy/sampled bit,
+    the rest are traced. Shared by the monolithic paths and the serving
+    engine so the two can never drift (their bit-equivalence is pinned in
+    tests/test_serving.py)."""
+    if not sampled:
+        return _sample_greedy(logits)
+    return _sample_traced(logits, temperature, key, top_k, top_p)
+
+
 def _generate_impl(
-    params, prompt, cfg, max_new_tokens, temperature, key,
+    params, prompt, cfg, max_new_tokens, sampled, temperature, key,
     max_len, top_k, top_p, tensor_axis=None, n_kv=None,
 ):
-    """Shared generation body: prefill over the prompt, then a fori_loop
-    of single-token decode steps against the cache. Runs plain (generate)
-    or inside shard_map (generate_tp)."""
+    """Shared monolithic generation body: prefill over the prompt, then a
+    fori_loop of single-token decode steps against the cache. Runs plain
+    (generate_monolithic) or inside shard_map (generate_tp_monolithic).
+    ``sampled`` is static; temperature/top_k/top_p arrive traced."""
     b, tp = prompt.shape
     total = tp + max_new_tokens
     max_len = max_len or total
@@ -260,7 +339,9 @@ def _generate_impl(
     logits, cache = forward(
         params, prompt, cfg, cache, 0, tensor_axis=tensor_axis
     )
-    next_tok = _sample(logits[:, -1], temperature, key, top_k, top_p)
+    next_tok = sample_token(
+        logits[:, -1], sampled, temperature, key, top_k, top_p
+    )
 
     out = jnp.zeros((b, total), jnp.int32)
     out = jax.lax.dynamic_update_slice(out, prompt.astype(jnp.int32), (0, 0))
@@ -272,9 +353,9 @@ def _generate_impl(
         logits, cache = forward(
             params, tok[:, None], cfg, cache, pos, tensor_axis=tensor_axis
         )
-        nxt = _sample(
-            logits[:, -1], temperature, jax.random.fold_in(key, i), top_k,
-            top_p,
+        nxt = sample_token(
+            logits[:, -1], sampled, temperature,
+            jax.random.fold_in(key, i), top_k, top_p,
         )
         out = out.at[:, pos + 1].set(nxt)
         return out, cache, nxt
@@ -286,13 +367,56 @@ def _generate_impl(
 
 
 # repolint: allow(jit-donation-decision) — params are the serving
-# weights, reused by every generate call; the cache is jit-internal.
+# weights, reused by every generate call; the cache is jit-internal on
+# this legacy reference path (the serving engine is the donated-cache
+# fast path).
 @partial(
     jax.jit,
-    static_argnames=(
-        "cfg", "max_new_tokens", "temperature", "max_len", "top_k", "top_p"
-    ),
+    static_argnames=("cfg", "max_new_tokens", "max_len", "sampled"),
 )
+def _monolithic_jit(
+    params, prompt, key, temperature, top_k, top_p,
+    *, cfg, max_new_tokens, max_len, sampled,
+):
+    return _generate_impl(
+        params, prompt, cfg, max_new_tokens, sampled, temperature, key,
+        max_len, top_k, top_p,
+    )
+
+
+def generate_monolithic(
+    params: Params,
+    prompt: jax.Array,  # [B, Tp] int
+    cfg: ModelConfig,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    max_len: int | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """The original single-program generation path: prefill + fori_loop of
+    decode steps inside ONE jit. Returns [B, Tp + max_new_tokens].
+
+    Kept as the reference the serving engine is pinned bit-equal against
+    and as decode_bench's "per-call path" leg. Sampling params are traced
+    (a config sweep reuses one compiled program — the compile key is only
+    (shapes, cfg, max_new_tokens, max_len, greedy-vs-sampled)); the KV
+    cache is jit-internal, re-allocated and re-zeroed every call — the
+    cost ``serving.engine.DecodeEngine``'s donated cache pool removes.
+    """
+    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
+    if early is not None:
+        return early
+    t, k, p = sampling_scalars(temperature, top_k, top_p, cfg.vocab_size)
+    return _monolithic_jit(
+        params, prompt, key, t, k, p,
+        cfg=cfg, max_new_tokens=max_new_tokens, max_len=max_len,
+        sampled=temperature > 0,
+    )
+
+
 def generate(
     params: Params,
     prompt: jax.Array,  # [B, Tp] int
@@ -307,16 +431,66 @@ def generate(
 ) -> jax.Array:
     """Autoregressive generation: returns [B, Tp + max_new_tokens].
 
-    One compiled program: prefill over the prompt, then a fori_loop of
-    single-token decode steps against the cache.
+    Compat shim over ``serving.engine.DecodeEngine`` (exact-length
+    buckets, so compilation behaviour matches the old monolithic entry):
+    prefill + decode run as two long-lived compiled programs with the KV
+    cache donated between them and pooled across calls. Bit-equal to
+    ``generate_monolithic`` (pinned in tests/test_serving.py).
     """
     early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
     if early is not None:
         return early
-    return _generate_impl(
-        params, prompt, cfg, max_new_tokens, temperature, key,
-        max_len, top_k, top_p,
+    from pytorch_distributed_tpu.serving.engine import shim_engine
+
+    engine = shim_engine(
+        cfg, max_len or (prompt.shape[1] + max_new_tokens)
     )
+    return engine.generate(
+        params, prompt, max_new_tokens, temperature=temperature, key=key,
+        top_k=top_k, top_p=top_p,
+    )
+
+
+def _validate_tp_mesh(cfg: ModelConfig, mesh_cfg) -> None:
+    """Shared generate_tp entry validation (shim + monolithic)."""
+    tp_size = mesh_cfg.tensor
+    if tp_size <= 1:
+        raise ValueError("generate_tp needs mesh_cfg.tensor > 1")
+    for ax in ("data", "fsdp", "seq", "pipe", "expert"):
+        if getattr(mesh_cfg, ax) > 1:
+            raise NotImplementedError(
+                f"generate_tp supports a tensor-only mesh (got {ax}="
+                f"{getattr(mesh_cfg, ax)})"
+            )
+    if cfg.n_experts and cfg.inner_dim % tp_size:
+        raise ValueError(
+            f"tensor={tp_size} must divide the MoE expert hidden dim "
+            f"inner_dim={cfg.inner_dim} (experts run Megatron TP on F)"
+        )
+    if cfg.n_head % tp_size or cfg.kv_heads % tp_size:
+        raise ValueError(
+            f"tensor={tp_size} must divide n_head={cfg.n_head} and "
+            f"kv_heads={cfg.kv_heads}"
+        )
+
+
+def _validate_fsdp_mesh(mesh_cfg) -> None:
+    """Shared generate_fsdp entry validation (shim + monolithic)."""
+    if mesh_cfg.fsdp <= 1:
+        raise ValueError("generate_fsdp needs mesh_cfg.fsdp > 1")
+    for ax in ("data", "tensor", "seq", "pipe", "expert"):
+        if getattr(mesh_cfg, ax) > 1:
+            raise NotImplementedError(
+                f"generate_fsdp supports an fsdp-only mesh (got {ax}="
+                f"{getattr(mesh_cfg, ax)}); combine with generate_tp's "
+                "tensor sharding is future surface"
+            )
+    if mesh_cfg.strategy != "full_shard":
+        raise ValueError(
+            "generate_fsdp decodes from full_shard (ZeRO-3) param "
+            f"layouts; strategy={mesh_cfg.strategy!r} keeps params "
+            "replicated — plain generate already covers it"
+        )
 
 
 def generate_tp(
@@ -340,37 +514,51 @@ def generate_tp(
     state decodes with no resharding); each shard runs attention on its
     LOCAL heads against a local-head KV cache (1/tp of the cache HBM),
     row-parallel projections psum over the axis, and the replicated
-    logits sample identically on every shard.
+    logits sample identically on every shard. Compat shim over the TP
+    ``DecodeEngine``; ``generate_tp_monolithic`` is the one-jit reference.
     """
-    tp_size = mesh_cfg.tensor
-    if tp_size <= 1:
-        raise ValueError("generate_tp needs mesh_cfg.tensor > 1")
-    for ax in ("data", "fsdp", "seq", "pipe", "expert"):
-        if getattr(mesh_cfg, ax) > 1:
-            raise NotImplementedError(
-                f"generate_tp supports a tensor-only mesh (got {ax}="
-                f"{getattr(mesh_cfg, ax)})"
-            )
-    if cfg.n_experts and cfg.inner_dim % tp_size:
-        raise ValueError(
-            f"tensor={tp_size} must divide the MoE expert hidden dim "
-            f"inner_dim={cfg.inner_dim} (experts run Megatron TP on F)"
-        )
-    if cfg.n_head % tp_size or cfg.kv_heads % tp_size:
-        raise ValueError(
-            f"tensor={tp_size} must divide n_head={cfg.n_head} and "
-            f"kv_heads={cfg.kv_heads}"
-        )
+    _validate_tp_mesh(cfg, mesh_cfg)
+    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
+    if early is not None:
+        return early
+    from pytorch_distributed_tpu.serving.engine import shim_engine
+
+    engine = shim_engine(
+        cfg, max_len or (prompt.shape[1] + max_new_tokens),
+        mesh_cfg=mesh_cfg,
+    )
+    return engine.generate(
+        params, prompt, max_new_tokens, temperature=temperature, key=key,
+        top_k=top_k, top_p=top_p,
+    )
+
+
+def generate_tp_monolithic(
+    params: Params,
+    prompt: jax.Array,  # [B, Tp] int
+    cfg: ModelConfig,
+    mesh_cfg,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    max_len: int | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """One-jit TP generation (the pre-engine reference path)."""
+    _validate_tp_mesh(cfg, mesh_cfg)
     early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
     if early is not None:
         return early
 
     fn, shardings = _tp_generate_compiled(
-        cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
+        cfg, mesh_cfg, max_new_tokens, max_len, temperature > 0
     )
+    t, k, p = sampling_scalars(temperature, top_k, top_p, cfg.vocab_size)
     # device_put with the target shardings is a no-op when params are
     # already placed, so repeat calls only pay the (cached) jit lookup.
-    return fn(jax.device_put(params, shardings), prompt, key)
+    return fn(jax.device_put(params, shardings), prompt, key, t, k, p)
 
 
 def _check_sample_args(prompt, max_new_tokens, temperature, key):
@@ -433,55 +621,74 @@ def generate_fsdp(
     PLACE from the layout full-shard training leaves the weights in (no
     resharding, and per-chip param HBM stays 1/fsdp of the model).
 
-    Unlike ``generate_tp`` (shard_map + hand-placed psums), this is the
-    auto path: the decode loop is jitted with the params carrying their
-    full_shard NamedShardings and XLA's SPMD partitioner inserts the
-    gathers. The stacked [L, ...] block leaves shard a WEIGHT dim (never
-    L — parallel/sharding.py), so inside the scan-over-layers each
-    iteration all_gathers only its own layer slice: one layer's gathered
-    weights are live at a time, the same per-block-gather discipline
-    full-shard training uses. MoE configs work unchanged (routing and
-    dispatch are ordinary auto-sharded ops here).
+    Compat shim over the ZeRO-3 ``DecodeEngine``: the auto-partitioned
+    decode with each scanned layer's shards gathered per layer — and,
+    with ``mesh_cfg.prefetch_buffers`` > 0, gathered a WINDOW at a time
+    so layer l+1's all-gather streams under layer l's compute (the same
+    ops/layer_scan schedule training's explicit ZeRO-3 path uses; closes
+    ROADMAP PR-3 follow-up (c)). ``generate_fsdp_monolithic`` is the
+    one-jit reference. MoE configs work unchanged (routing and dispatch
+    are ordinary auto-sharded ops here).
     """
-    if mesh_cfg.fsdp <= 1:
-        raise ValueError("generate_fsdp needs mesh_cfg.fsdp > 1")
-    for ax in ("data", "tensor", "seq", "pipe", "expert"):
-        if getattr(mesh_cfg, ax) > 1:
-            raise NotImplementedError(
-                f"generate_fsdp supports an fsdp-only mesh (got {ax}="
-                f"{getattr(mesh_cfg, ax)}); combine with generate_tp's "
-                "tensor sharding is future surface"
-            )
-    if mesh_cfg.strategy != "full_shard":
-        raise ValueError(
-            "generate_fsdp decodes from full_shard (ZeRO-3) param "
-            f"layouts; strategy={mesh_cfg.strategy!r} keeps params "
-            "replicated — plain generate already covers it"
-        )
+    _validate_fsdp_mesh(mesh_cfg)
+    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
+    if early is not None:
+        return early
+    from pytorch_distributed_tpu.serving.engine import shim_engine
+
+    engine = shim_engine(
+        cfg, max_len or (prompt.shape[1] + max_new_tokens),
+        mesh_cfg=mesh_cfg,
+    )
+    return engine.generate(
+        params, prompt, max_new_tokens, temperature=temperature, key=key,
+        top_k=top_k, top_p=top_p,
+    )
+
+
+def generate_fsdp_monolithic(
+    params: Params,
+    prompt: jax.Array,  # [B, Tp] int
+    cfg: ModelConfig,
+    mesh_cfg,
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+    max_len: int | None = None,
+    top_k: int | None = None,
+    top_p: float | None = None,
+) -> jax.Array:
+    """One-jit ZeRO-3 generation (the pre-engine reference path): the
+    decode loop is jitted with params carrying their full_shard
+    NamedShardings and XLA's SPMD partitioner inserts the just-in-time
+    per-layer gathers (the stacked [L, ...] block leaves shard a WEIGHT
+    dim, never L — parallel/sharding.py)."""
+    _validate_fsdp_mesh(mesh_cfg)
     early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
     if early is not None:
         return early
 
     fn, shardings = _fsdp_generate_compiled(
-        cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
+        cfg, mesh_cfg, max_new_tokens, max_len, temperature > 0
     )
-    return fn(jax.device_put(params, shardings), prompt, key)
+    t, k, p = sampling_scalars(temperature, top_k, top_p, cfg.vocab_size)
+    return fn(jax.device_put(params, shardings), prompt, key, t, k, p)
 
 
 @functools.lru_cache(maxsize=None)
-def _fsdp_generate_compiled(
-    cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
-):
+def _fsdp_generate_compiled(cfg, mesh_cfg, max_new_tokens, max_len, sampled):
     """(jitted auto-path generate fn, full_shard param shardings) for one
-    static config — cached like _tp_generate_compiled."""
+    static config — cached like _tp_generate_compiled. Sampling params
+    are call-time traced operands, so they are NOT part of this key."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh, _, shardings = _mesh_param_shardings(cfg, mesh_cfg)
     replicated = NamedSharding(mesh, P())
 
-    def body(params, prompt, key):
+    def body(params, prompt, key, temperature, top_k, top_p):
         return _generate_impl(
-            params, prompt, cfg, max_new_tokens, temperature, key,
+            params, prompt, cfg, max_new_tokens, sampled, temperature, key,
             max_len, top_k, top_p,
         )
 
@@ -489,30 +696,29 @@ def _fsdp_generate_compiled(
     # are reused across generate_fsdp calls; nothing here is consumed.
     fn = jax.jit(
         body,
-        in_shardings=(shardings, replicated, replicated),
+        in_shardings=(shardings,) + (replicated,) * 5,
         out_shardings=replicated,
     )
     return fn, shardings
 
 
 @functools.lru_cache(maxsize=None)
-def _tp_generate_compiled(
-    cfg, mesh_cfg, max_new_tokens, temperature, max_len, top_k, top_p
-):
+def _tp_generate_compiled(cfg, mesh_cfg, max_new_tokens, max_len, sampled):
     """(jitted shard_map generate fn, param shardings) for one static
     config — cached so a serving loop does not retrace/recompile the
     whole prefill+fori_loop program per generate_tp call (both config
-    dataclasses are frozen, hence hashable). Param specs are derived
-    from the abstract init so the cache needs no concrete params."""
+    dataclasses are frozen, hence hashable; traced sampling params are
+    NOT part of the key). Param specs are derived from the abstract init
+    so the cache needs no concrete params."""
     from jax.sharding import PartitionSpec as P
 
     from pytorch_distributed_tpu.utils.compat import shard_map
 
     mesh, p_specs, shardings = _mesh_param_shardings(cfg, mesh_cfg)
 
-    def body(params, prompt, key):
+    def body(params, prompt, key, temperature, top_k, top_p):
         return _generate_impl(
-            params, prompt, cfg, max_new_tokens, temperature, key,
+            params, prompt, cfg, max_new_tokens, sampled, temperature, key,
             max_len, top_k, top_p,
             tensor_axis="tensor", n_kv=cfg.kv_heads // mesh_cfg.tensor,
         )
@@ -520,10 +726,11 @@ def _tp_generate_compiled(
     smapped = shard_map(
         body,
         mesh=mesh,
-        in_specs=(p_specs, P(), P()),
+        in_specs=(p_specs, P(), P(), P(), P(), P()),
         out_specs=P(),
         check_vma=True,
     )
     # repolint: allow(jit-donation-decision) — TP serving weights are
-    # reused across generate_tp calls; the KV cache is jit-internal.
+    # reused across generate_tp calls; the KV cache is jit-internal on
+    # this reference path.
     return jax.jit(smapped), shardings

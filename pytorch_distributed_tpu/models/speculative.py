@@ -39,6 +39,19 @@ The loop is greedy-only: temperature sampling needs rejection-sampling
 corrections to stay distribution-exact, which is out of scope here and
 rejected loudly. Single sequence (B=1): acceptance length varies per
 row, which would need per-row cache offsets; batch the PROMPTS instead.
+
+Why the KV cache stays jit-internal (NOT routed through the serving
+engine's donated cache): the verify loop is a ``lax.while_loop`` whose
+per-iteration forward length is K+1 and whose trip count depends on
+acceptance — the cache never crosses a program boundary, so there is
+nothing to donate ACROSS; splitting the loop into per-iteration engine
+dispatches would add one host round-trip per verify step (the latency
+speculative decoding exists to amortise) to save one cache
+allocation+zero-fill per CALL — a [L, 1, S, Hkv, D] memset amortised
+over the whole generation, measured in the noise next to a single
+verify forward. The decision is pinned where it can't rot:
+tests/test_speculative.py asserts bit-equivalence against BOTH the
+monolithic greedy reference and the serving engine's greedy output.
 """
 
 from __future__ import annotations
@@ -90,7 +103,10 @@ def _lookup_draft(out_buf, pos, *, ngram: int, draft_len: int, total: int):
 
 
 # repolint: allow(jit-donation-decision) — params are the serving
-# weights, reused by every speculative-decode call.
+# weights, reused by every speculative-decode call; the KV cache is
+# deliberately jit-internal (the verify while_loop never crosses a
+# program boundary — see module docstring "Why the KV cache stays
+# jit-internal"), so there is no donated-cache variant to prefer.
 @partial(
     jax.jit,
     static_argnames=("cfg", "max_new_tokens", "draft_len", "ngram",

@@ -44,6 +44,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 
 from pytorch_distributed_tpu.ops.remat import apply_remat
 
@@ -75,6 +76,7 @@ def scan_layers(
     block_transform: Callable | None = None,
     prefetch_buffers: int = 0,
     unroll: int = 1,
+    collect_ys: bool = False,
 ):
     """Run ``block_fn`` over every layer of a stacked [L, ...] param tree.
 
@@ -85,6 +87,16 @@ def scan_layers(
     explicit-FSDP gather hook); with ``prefetch_buffers`` > 0 the
     transforms of a whole window are hoisted above its compute (see
     module docstring). Returns the final carry.
+
+    ``collect_ys``: when True, ``block_fn`` returns ``(carry, y)`` and the
+    per-layer ys are stacked back to [L, ...] and returned alongside the
+    carry — the decode path's per-layer KV-cache updates ride this the
+    same way training's scan outputs would, so the windowed prefetch
+    schedule applies to inference too (serving/engine.py's ZeRO-3 decode).
+    In window mode the per-window ys are stacked [W, ...] inside the body
+    and reshaped [n_windows, W, ...] -> [L, ...] afterwards — the same
+    layer order as the W=1 scan, so ys stay bit-identical across window
+    sizes.
     """
     n_layer = jax.tree.leaves(blocks)[0].shape[0]
     window = effective_window(prefetch_buffers, n_layer)
@@ -97,15 +109,17 @@ def scan_layers(
         # model code): transform + compute inside one rematted body.
         def body(c, xs):
             bp, extra = xs
+            if collect_ys:
+                return block_fn(c, transform(bp), extra)
             return block_fn(c, transform(bp), extra), None
 
-        (carry, _) = jax.lax.scan(
+        (carry, ys) = jax.lax.scan(
             apply_remat(body, remat_mode),
             carry,
             (blocks, extras),
             unroll=unroll,
         )
-        return carry
+        return (carry, ys) if collect_ys else carry
 
     n_windows = n_layer // window
     blocks_w = jax.tree.map(
@@ -124,16 +138,29 @@ def scan_layers(
             transform(jax.tree.map(lambda a, j=j: a[j], bw))
             for j in range(window)
         ]
+        ys_w = []
         for j in range(window):
-            c = block_fn(
+            out = block_fn(
                 c, gathered[j], jax.tree.map(lambda a, j=j: a[j], ew)
             )
+            if collect_ys:
+                c, y = out
+                ys_w.append(y)
+            else:
+                c = out
+        if collect_ys:
+            return c, jax.tree.map(lambda *zs: jnp.stack(zs), *ys_w)
         return c, None
 
-    (carry, _) = jax.lax.scan(
+    (carry, ys) = jax.lax.scan(
         apply_remat(window_body, remat_mode),
         carry,
         (blocks_w, extras_w),
         unroll=unroll,
     )
+    if collect_ys:
+        ys = jax.tree.map(
+            lambda a: a.reshape((n_layer,) + a.shape[2:]), ys
+        )
+        return carry, ys
     return carry

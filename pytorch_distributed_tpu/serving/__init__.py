@@ -1,0 +1,7 @@
+"""Serving fast path: the persistent donated-KV decode engine."""
+
+from pytorch_distributed_tpu.serving.engine import (  # noqa: F401
+    BucketSpec,
+    DecodeEngine,
+    shim_engine,
+)

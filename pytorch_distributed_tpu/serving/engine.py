@@ -1,0 +1,562 @@
+"""Persistent donated-KV decode engine: the serving fast path.
+
+The monolithic ``generate`` programs (models/decode.py) are the wrong
+shape for a serving loop: the KV cache is jit-internal (re-allocated and
+re-zeroed every request), every distinct prompt length compiles a fresh
+prefill+loop program, and — before this PR — every sampling config change
+recompiled too. ``DecodeEngine`` restructures generation into two
+long-lived compiled programs, the shape TPU serving practice settles on
+(Fine-Tuning and Serving Gemma on Cloud TPU; the pjit-scaling playbook —
+PAPERS.md):
+
+- ``prefill(params, prompt, prompt_len, cache, t, k, p, key)``
+  runs the whole (bucket-padded) prompt and samples the first token;
+- ``decode_run(params, tok, cache, pos, n, t, k, p, key)``
+  runs n single-token steps in one dispatch (a fori_loop with a TRACED
+  trip count — one compile covers every generation length);
+- ``decode_step(...)`` is the single-step form behind ``stream()``.
+
+Three levers, all machine-checked:
+
+1. **Buffer donation**: the cache is ``donate_argnums``-donated through
+   every program, and the engine keeps the returned buffer in a pool —
+   steady-state serving allocates and zero-fills NOTHING per request.
+   Reusing a dirty buffer is sound because decode's cache discipline
+   (models/decode.py) masks key positions > pos and overwrites each row
+   before it becomes readable; tests/test_serving.py pins it, including
+   the GQA edge. Donation is verified to actually alias in the compiled
+   executable (``verify_donation`` + the strict mode of
+   analysis/audit.check_donation) — a silently-rejected alias would
+   double-buffer the largest tensor in the server.
+2. **Bounded compilation**: prompts are padded to a small set of
+   ``BucketSpec`` lengths (default powers of two), so steady-state
+   serving compiles O(buckets) prefill programs + ONE decode program —
+   not O(requests). Sampling params are traced scalars
+   (decode.sampling_scalars); only greedy-vs-sampled is static.
+3. **Comm/compute overlap (ZeRO-3 mode)**: decode from full-shard
+   training layouts routes the layer scan through
+   ops/layer_scan.scan_layers's windowed double-buffer schedule
+   (``MeshConfig.prefetch_buffers``), so layer l+1's param all-gathers
+   stream in under layer l's compute — the decode-side twin of the
+   explicit training path's prefetch (closes ROADMAP PR-3 follow-up (c)).
+
+Modes (one engine per mode x config):
+- plain: single device, whole params.
+- tp (``mesh_cfg.tensor`` > 1): shard_map over a "tensor" mesh, Megatron
+  layouts, local-head cache shards (the cache pytree is a GLOBAL array
+  sharded over the head dim — 1/tp of the cache HBM per chip).
+- zero3 (``mesh_cfg.fsdp`` > 1, full_shard): auto-partitioned decode in
+  the ZeRO-3 training layout with the windowed gather schedule above.
+
+Outputs are bit-equal to the monolithic reference paths for identical
+requests (greedy and fixed-key sampled) — same forward, same sampler,
+same key-folding schedule; padded prompt rows and pooled-buffer garbage
+are masked out of every reduction. Pinned by tests/test_serving.py.
+
+Not thread-safe: the cache pool hands the SAME buffer to concurrent
+requests of one batch size. Serialise requests per engine (or shard
+engines per worker).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
+from pytorch_distributed_tpu.models import decode
+
+_PROGRAM_KINDS = ("prefill", "decode_run", "decode_step")
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """Prompt-length buckets. A request of length T compiles (at most)
+    the program of the smallest bucket >= T; ``()`` means exact-length
+    (no padding — one compile per distinct length, the compat-shim
+    behaviour)."""
+
+    buckets: tuple[int, ...] = ()
+
+    def __post_init__(self) -> None:
+        b = tuple(self.buckets)
+        if any(x <= 0 for x in b) or list(b) != sorted(set(b)):
+            raise ValueError(
+                f"buckets must be strictly increasing positives, got {b}"
+            )
+        object.__setattr__(self, "buckets", b)
+
+    @classmethod
+    def powers_of_two(
+        cls, max_len: int, min_bucket: int = 128
+    ) -> "BucketSpec":
+        """128/256/.../max_len (first bucket = min_bucket clipped to
+        max_len; max_len itself is always the last bucket so every
+        admissible prompt has a home)."""
+        if min_bucket <= 0 or max_len <= 0:
+            raise ValueError("min_bucket and max_len must be positive")
+        out = []
+        b = min_bucket
+        while b < max_len:
+            out.append(b)
+            b *= 2
+        out.append(max_len)
+        return cls(tuple(out))
+
+    def bucket_for(self, length: int) -> int:
+        if not self.buckets:
+            return length
+        for b in self.buckets:
+            if b >= length:
+                return b
+        raise ValueError(
+            f"prompt length {length} exceeds the largest bucket "
+            f"{self.buckets[-1]}"
+        )
+
+
+class DecodeEngine:
+    """See module docstring. Construct once per (cfg, max_len, bucket
+    spec, mesh); call ``generate`` / ``stream`` per request with any
+    params matching ``cfg`` (params are call arguments, not engine state,
+    so one engine serves many checkpoints of one architecture)."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        *,
+        max_len: int,
+        buckets: BucketSpec | None = None,
+        mesh_cfg: MeshConfig | None = None,
+        pool_caches: bool = True,
+    ) -> None:
+        if max_len > cfg.n_ctx:
+            raise ValueError(
+                f"max_len {max_len} exceeds n_ctx {cfg.n_ctx}"
+            )
+        self.cfg = cfg
+        self.max_len = int(max_len)
+        self.buckets = buckets or BucketSpec()
+        if self.buckets.buckets and self.buckets.buckets[-1] > max_len:
+            raise ValueError(
+                f"largest bucket {self.buckets.buckets[-1]} exceeds "
+                f"max_len {max_len}"
+            )
+        self.mesh_cfg = mesh_cfg
+        self._n_kv = None
+        self._prefetch_buffers = 0
+        if mesh_cfg is None or mesh_cfg.num_devices == 1:
+            self.mode = "plain"
+            self.mesh_cfg = None
+        elif mesh_cfg.tensor > 1:
+            decode._validate_tp_mesh(cfg, mesh_cfg)
+            self.mode = "tp"
+            self._n_kv = cfg.kv_heads // mesh_cfg.tensor
+        else:
+            decode._validate_fsdp_mesh(mesh_cfg)
+            self.mode = "zero3"
+            self._prefetch_buffers = mesh_cfg.prefetch_buffers
+        if self.mode != "plain":
+            (
+                self._mesh, self._p_specs, self._param_shardings
+            ) = decode._mesh_param_shardings(cfg, self.mesh_cfg)
+        # (kind, sampled) -> jitted program. Prefill additionally
+        # specialises per bucket shape through jit's own shape cache, so
+        # compile_count() reads len(buckets)-many entries off ONE program.
+        self._programs: dict[tuple[str, bool], Any] = {}
+        # batch -> dirty-but-reusable donated cache buffer. pool_caches
+        # False (the compat shims) frees the cache after each request
+        # instead — a shim engine exists per (cfg, max_len, mesh) and
+        # lives forever in shim_engine's cache, so pooling there would
+        # pin one full-size cache per distinct request shape; a real
+        # serving deployment constructs ONE engine and wants the pool.
+        self._pool_caches = pool_caches
+        self._cache_pool: dict[int, decode.Cache] = {}
+
+    # -- cache pool --------------------------------------------------------
+
+    def new_cache(self, batch: int) -> decode.Cache:
+        """Freshly-zeroed cache placed for this engine's mode (the pool
+        bypasses this after the first request per batch size)."""
+        if self.mode == "tp":
+            # Global [L, B, S, Hkv, D] array sharded over the head dim:
+            # each shard holds its LOCAL kv heads, matching the local
+            # n_kv view forward sees inside shard_map.
+            full = decode.init_cache(self.cfg, batch, self.max_len)
+            return jax.device_put(full, self._cache_sharding())
+        return decode.init_cache(
+            self.cfg, batch, self.max_len, n_kv=self._n_kv
+        )
+
+    def _take_cache(self, batch: int) -> decode.Cache:
+        return self._cache_pool.pop(batch, None) or self.new_cache(batch)
+
+    def _return_cache(self, batch: int, cache: decode.Cache) -> None:
+        if self._pool_caches:
+            self._cache_pool[batch] = cache
+
+    def _cache_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = self._cache_spec()
+        return jax.tree.map(
+            lambda s: NamedSharding(self._mesh, s), spec,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    def _cache_spec(self):
+        from jax.sharding import PartitionSpec as P
+
+        s = (
+            P(None, None, None, "tensor", None)
+            if self.mode == "tp"
+            else P()
+        )
+        return {"k": s, "v": s}
+
+    # -- program construction ---------------------------------------------
+
+    def _forward(self, params, ids, cache, pos):
+        kwargs = {}
+        if self.mode == "tp":
+            kwargs["tensor_axis"] = "tensor"
+        elif self.mode == "zero3":
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            replicated = NamedSharding(self._mesh, P())
+            kwargs["block_transform"] = lambda bp: jax.tree.map(
+                lambda a: jax.lax.with_sharding_constraint(a, replicated),
+                bp,
+            )
+            kwargs["prefetch_buffers"] = self._prefetch_buffers
+        return decode.forward(params, ids, self.cfg, cache, pos, **kwargs)
+
+    def _bodies(self, sampled: bool):
+        """The three raw program bodies for one greedy/sampled variant.
+        Sampling scalars are always in the signature (greedy programs
+        trace-and-drop them) so every program keys the same way."""
+
+        def prefill(params, prompt, prompt_len, cache,
+                    temperature, top_k, top_p, key):
+            logits, cache = self._forward(params, prompt, cache, 0)
+            last = jax.lax.dynamic_slice_in_dim(
+                logits, prompt_len - 1, 1, axis=1
+            )[:, 0]
+            tok = decode.sample_token(
+                last, sampled, temperature, key, top_k, top_p
+            )
+            return tok, cache
+
+        def decode_run(params, tok, cache, pos, n_steps,
+                       temperature, top_k, top_p, key):
+            out = jnp.zeros((tok.shape[0], self.max_len), jnp.int32)
+
+            def step(i, carry):
+                out, cache, tok = carry
+                logits, cache = self._forward(
+                    params, tok[:, None], cache, pos + i
+                )
+                nxt = decode.sample_token(
+                    logits[:, -1], sampled, temperature,
+                    jax.random.fold_in(key, i), top_k, top_p,
+                )
+                return out.at[:, i].set(nxt), cache, nxt
+
+            out, cache, _ = jax.lax.fori_loop(
+                0, n_steps, step, (out, cache, tok)
+            )
+            return out, cache
+
+        def decode_step(params, tok, cache, pos,
+                        temperature, top_k, top_p, key):
+            logits, cache = self._forward(params, tok[:, None], cache, pos)
+            tok = decode.sample_token(
+                logits[:, -1], sampled, temperature, key, top_k, top_p
+            )
+            return tok, cache
+
+        return {
+            "prefill": prefill,
+            "decode_run": decode_run,
+            "decode_step": decode_step,
+        }
+
+    # The cache's positional index in each program signature — the
+    # donate_argnums every mode passes and the donation audit verifies.
+    CACHE_ARGNUM = {"prefill": 3, "decode_run": 2, "decode_step": 2}
+
+    def program(self, kind: str, sampled: bool):
+        """The jitted program for (kind, greedy/sampled), built lazily.
+        Public so the audit registry (analysis/registry.py) and tests can
+        lower/compile the exact programs the engine dispatches."""
+        if kind not in _PROGRAM_KINDS:
+            raise KeyError(f"unknown program kind {kind!r}")
+        prog = self._programs.get((kind, sampled))
+        if prog is not None:
+            return prog
+        body = self._bodies(sampled)[kind]
+        donate = (self.CACHE_ARGNUM[kind],)
+        if self.mode == "plain":
+            prog = jax.jit(body, donate_argnums=donate)
+        elif self.mode == "tp":
+            from jax.sharding import PartitionSpec as P
+
+            from pytorch_distributed_tpu.utils.compat import shard_map
+
+            cache_spec = self._cache_spec()
+            # Everything but the params and the head-sharded cache is
+            # replicated; signatures per _bodies.
+            specs = {
+                "prefill": (
+                    self._p_specs, P(), P(), cache_spec, P(), P(), P(), P()
+                ),
+                "decode_run": (
+                    self._p_specs, P(), cache_spec,
+                    P(), P(), P(), P(), P(), P(),
+                ),
+                "decode_step": (
+                    self._p_specs, P(), cache_spec, P(), P(), P(), P(), P()
+                ),
+            }[kind]
+            smapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=specs,
+                out_specs=(P(), cache_spec),
+                check_vma=True,
+            )
+            prog = jax.jit(smapped, donate_argnums=donate)
+        else:  # zero3
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            replicated = NamedSharding(self._mesh, P())
+            n_args = {"prefill": 8, "decode_run": 9, "decode_step": 8}[kind]
+            in_sh = [replicated] * n_args
+            in_sh[0] = self._param_shardings
+            prog = jax.jit(
+                body,
+                in_shardings=tuple(in_sh),
+                out_shardings=(replicated, replicated),
+                donate_argnums=donate,
+            )
+        self._programs[(kind, sampled)] = prog
+        return prog
+
+    def _place_params(self, params):
+        if self.mode == "plain":
+            return params
+        # No-op when already placed, so repeat calls pay nothing.
+        return jax.device_put(params, self._param_shardings)
+
+    # -- request API -------------------------------------------------------
+
+    def _request_setup(self, prompt, max_new_tokens, temperature,
+                       top_k, top_p):
+        prompt = jnp.asarray(prompt)
+        b, tp = prompt.shape
+        if tp + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({tp}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the engine max_len {self.max_len}"
+            )
+        bucket = self.buckets.bucket_for(tp)
+        padded = (
+            prompt
+            if bucket == tp
+            else jnp.pad(prompt, ((0, 0), (0, bucket - tp)))
+        )
+        t, k, p = decode.sampling_scalars(
+            temperature, top_k, top_p, self.cfg.vocab_size
+        )
+        return prompt, padded, b, tp, t, k, p
+
+    def generate(
+        self,
+        params,
+        prompt: jax.Array,  # [B, Tp] int
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        key: jax.Array | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
+    ) -> jax.Array:
+        """Serve one request: returns [B, Tp + max_new_tokens] — the same
+        tokens the monolithic reference produces for this request."""
+        early, key = decode._check_sample_args(
+            prompt, max_new_tokens, temperature, key
+        )
+        if early is not None:
+            return early
+        prompt, padded, b, tp, t, k, p = self._request_setup(
+            prompt, max_new_tokens, temperature, top_k, top_p
+        )
+        sampled = temperature > 0
+        params = self._place_params(params)
+        cache = self._take_cache(b)
+        plen = jnp.asarray(tp, jnp.int32)
+
+        # A failed dispatch DROPS the buffer instead of pooling it: once
+        # a program was dispatched its donated input is consumed whether
+        # or not the call succeeded, so returning it would poison the
+        # pool with a deleted array; the next request simply re-allocates
+        # (the cost a healthy pool avoids, paid only after a failure).
+        try:
+            tok, cache = self.program("prefill", sampled)(
+                params, padded, plen, cache, t, k, p, key
+            )
+            pieces = [prompt.astype(jnp.int32), tok[:, None]]
+            n = max_new_tokens - 1
+            if n > 0:
+                out, cache = self.program("decode_run", sampled)(
+                    params, tok, cache, plen, jnp.asarray(n, jnp.int32),
+                    t, k, p, key,
+                )
+                pieces.append(out[:, :n])
+        except BaseException:
+            cache = None
+            raise
+        finally:
+            if cache is not None:
+                self._return_cache(b, cache)
+        return jnp.concatenate(pieces, axis=1)
+
+    def stream(
+        self,
+        params,
+        prompt: jax.Array,  # [B, Tp] int
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        key: jax.Array | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
+    ):
+        """Generator of [B] int32 token arrays, one per ``decode_step``
+        dispatch — the streaming form of ``generate`` (identical tokens:
+        same programs modulo the fused loop, same key folding). The cache
+        buffer returns to the pool when the generator finishes or is
+        closed."""
+        early, key = decode._check_sample_args(
+            prompt, max_new_tokens, temperature, key
+        )
+        if early is not None:
+            return
+        prompt, padded, b, tp, t, k, p = self._request_setup(
+            prompt, max_new_tokens, temperature, top_k, top_p
+        )
+        sampled = temperature > 0
+        params = self._place_params(params)
+        cache = self._take_cache(b)
+        plen = jnp.asarray(tp, jnp.int32)
+        # Same drop-on-dispatch-failure rule as generate(); an early
+        # generator close (GeneratorExit at a yield) is NOT a failed
+        # dispatch — `cache` is the last returned buffer and goes back
+        # to the pool.
+        try:
+            tok, cache = self.program("prefill", sampled)(
+                params, padded, plen, cache, t, k, p, key
+            )
+            yield tok
+            step = self.program("decode_step", sampled)
+            for i in range(max_new_tokens - 1):
+                tok, cache = step(
+                    params, tok, cache, jnp.asarray(tp + i, jnp.int32),
+                    t, k, p, jax.random.fold_in(key, i),
+                )
+                yield tok
+        except GeneratorExit:
+            raise
+        except BaseException:
+            cache = None
+            raise
+        finally:
+            if cache is not None:
+                self._return_cache(b, cache)
+
+    # -- introspection -----------------------------------------------------
+
+    def compile_count(self) -> int:
+        """Total compiled executables across the engine's programs (the
+        number a mixed-length request stream is asserted against:
+        n_buckets prefills + 1 decode program per greedy/sampled mode)."""
+        return sum(p._cache_size() for p in self._programs.values())
+
+    def example_args(self, kind: str, params, *, batch: int = 1,
+                     prompt_len: int | None = None, sampled: bool = True):
+        """Example argument tuple for (lowering/auditing) ``kind`` — the
+        shapes ``generate`` dispatches with."""
+        tp = prompt_len or min(
+            self.buckets.buckets[0] if self.buckets.buckets else 4,
+            self.max_len - 1,
+        )
+        bucket = self.buckets.bucket_for(tp)
+        t, k, p = decode.sampling_scalars(
+            0.8 if sampled else 0.0, None, None, self.cfg.vocab_size
+        )
+        cache = self.new_cache(batch)
+        key = jax.random.key(0)
+        plen = jnp.asarray(tp, jnp.int32)
+        prompt = jnp.zeros((batch, bucket), jnp.int32)
+        tok = jnp.zeros((batch,), jnp.int32)
+        if kind == "prefill":
+            return (params, prompt, plen, cache, t, k, p, key)
+        if kind == "decode_run":
+            return (
+                params, tok, cache, plen, jnp.asarray(2, jnp.int32),
+                t, k, p, key,
+            )
+        if kind == "decode_step":
+            return (params, tok, cache, plen, t, k, p, key)
+        raise KeyError(f"unknown program kind {kind!r}")
+
+    def verify_donation(self, params, *, batch: int = 1,
+                        sampled: bool = True) -> dict[str, dict]:
+        """Prove the KV cache actually aliases in/out of every engine
+        program: lower + compile each (without running) and check the
+        compiled module's input_output_alias map covers every cache leaf.
+        Raises RuntimeError naming the program otherwise — a silently
+        rejected donation would double-buffer the cache on every step.
+        Returns {kind: alias stats} for reporting."""
+        from pytorch_distributed_tpu.analysis.audit import check_donation
+
+        stats_all: dict[str, dict] = {}
+        for kind in _PROGRAM_KINDS:
+            args = self.example_args(
+                kind, params, batch=batch, sampled=sampled
+            )
+            compiled = self.program(kind, sampled).lower(*args).compile()
+            findings, stats = check_donation(
+                compiled.as_text(), args, (self.CACHE_ARGNUM[kind],),
+                strict=True,
+            )
+            stats_all[kind] = stats
+            if findings:
+                raise RuntimeError(
+                    f"engine program {kind!r} ({self.mode}): donated KV "
+                    "cache does not fully alias in the compiled "
+                    f"executable — {findings[0].message}"
+                )
+        return stats_all
+
+
+@functools.lru_cache(maxsize=None)
+def shim_engine(
+    cfg: ModelConfig, max_len: int, mesh_cfg: MeshConfig | None = None
+) -> DecodeEngine:
+    """Engine cache backing the models/decode.generate* compat shims:
+    exact-length buckets (identical compile behaviour to the old
+    monolithic entry — one prefill compile per distinct prompt length)
+    and one engine per (cfg, max_len, mesh). Cache pooling is OFF so a
+    shim call frees its cache like the old jit-internal path did — these
+    engines live forever in this lru_cache, and a pooled cache per
+    distinct (max_len, batch) would grow device memory with request
+    diversity. Real serving loops should construct a DecodeEngine
+    directly with a fixed max_len and power-of-two buckets (pooling on)."""
+    return DecodeEngine(
+        cfg, max_len=max_len, mesh_cfg=mesh_cfg, pool_caches=False
+    )

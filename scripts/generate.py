@@ -79,6 +79,10 @@ def main() -> int:
                          "single-device.")
     ap.add_argument("--ngram", type=int, default=2,
                     help="lookup n-gram width for --speculative")
+    ap.add_argument("--stream", action="store_true",
+                    help="print tokens as the serving engine's "
+                         "decode_step emits them (serving/engine.py "
+                         "split prefill/decode API; works with --mesh)")
     args = ap.parse_args()
 
     from _common import setup_platform
@@ -89,6 +93,12 @@ def main() -> int:
         raise SystemExit(
             "--speculative is single-device (the verify loop owns the "
             "cache offsets); drop --mesh"
+        )
+    if args.speculative and args.stream:
+        raise SystemExit(
+            "--speculative commits a variable number of tokens per "
+            "verify step inside one program; it cannot stream through "
+            "the per-token decode_step API — drop one of the flags"
         )
     if args.speculative and args.temperature > 0:
         raise SystemExit(
@@ -212,6 +222,39 @@ def main() -> int:
         top_k=args.top_k,
         top_p=args.top_p,
     )
+    if args.stream:
+        # The split-step serving API end-to-end: one prefill dispatch,
+        # then one decode_step dispatch per printed token (all modes —
+        # the engine owns the mesh placement).
+        from pytorch_distributed_tpu.serving.engine import DecodeEngine
+
+        engine = DecodeEngine(
+            cfg,
+            max_len=ids.shape[1] + args.max_new_tokens,
+            mesh_cfg=mesh_cfg,
+        )
+        out_ids: list[int] = []
+        shown = ""
+        for step_tok in engine.stream(
+            params, jax.numpy.asarray(ids), args.max_new_tokens,
+            **sample_kw,
+        ):
+            out_ids.append(int(np.asarray(step_tok)[0]))
+            if tok is not None:
+                # Re-decode the whole continuation and print the delta:
+                # BPE merges mean the text for token i can change once
+                # token i+1 lands, so per-token decode would garble
+                # multibyte/merged pieces.
+                text = tok.decode(out_ids)
+                print(text[len(shown):], end="", flush=True)
+                shown = text
+            else:
+                print(
+                    ("," if len(out_ids) > 1 else "") + str(out_ids[-1]),
+                    end="", flush=True,
+                )
+        print()
+        return 0
     if mesh_cfg is not None:
         gen = (
             decode.generate_tp if mesh_cfg.tensor > 1

@@ -908,3 +908,28 @@ def test_stable_max_counts_pinned_for_schedule_cases(eight_devices):
     assert pf_budget.async_min_compute == 1
     _, _, z2_budget, _ = cases["zero2_bucketed"].build()
     assert z2_budget.async_min_compute is None
+
+
+def test_decode_engine_cases_pinned(eight_devices):
+    """The serving-engine registry cases (PR 4) carry their contracts:
+    strict donated-cache aliasing at the cache's real argnum on all
+    three, NO_COLLECTIVES on the single-device programs, and the
+    measured gather ceiling + overlap contract on the ZeRO-3 prefetch
+    decode."""
+    from pytorch_distributed_tpu.analysis.budget import STABLE_MAX_COUNTS
+    from pytorch_distributed_tpu.analysis.registry import registered_cases
+
+    cases = registered_cases()
+    for name, cache_argnum in (
+        ("decode_prefill", 3), ("decode_step", 2),
+    ):
+        _, _, budget, kwargs = cases[name].build()
+        assert budget.forbidden, name  # NO_COLLECTIVES
+        assert kwargs["donation_strict"], name
+        assert kwargs["donate_argnums"] == (cache_argnum,), name
+    _, _, zbudget, zkwargs = cases["zero3_decode_prefetch"].build()
+    assert zbudget.max_counts == STABLE_MAX_COUNTS["zero3_decode_prefetch"]
+    assert zbudget.async_min_compute == 1
+    assert "all-gather" in zbudget.required
+    assert zkwargs["donation_strict"]
+    assert zkwargs["donate_argnums"] == (2,)

@@ -160,6 +160,33 @@ def test_generate_top_p_one_keeps_full_support_and_tiny_p_is_greedy():
     assert bool((np.asarray(full_p) < cfg.vocab_size).all())
 
 
+def test_generate_no_recompile_across_sampling_configs():
+    """Sampling params are TRACED on the legacy monolithic path too: a
+    sweep over temperature/top_k/top_p values reuses ONE compiled
+    program per (shape, greedy-vs-sampled) — the recompile-per-config
+    regression the serving PR fixed (temperature/top_k/top_p used to be
+    static_argnames)."""
+    cfg = _cfg("gpt2")
+    params = get_model(cfg).init(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, cfg.vocab_size)
+    key = jax.random.key(1)
+    kwargs = dict(max_len=16, key=key)
+
+    decode.generate_monolithic(
+        params, prompt, cfg, 5, temperature=0.5, **kwargs
+    )
+    baseline = decode._monolithic_jit._cache_size()
+    for t, k, p in [(1.0, None, None), (0.7, 5, None), (1.3, None, 0.9),
+                    (0.9, 11, 0.5)]:
+        decode.generate_monolithic(
+            params, prompt, cfg, 5, temperature=t, top_k=k, top_p=p,
+            **kwargs,
+        )
+    assert decode._monolithic_jit._cache_size() == baseline, (
+        "sampling-config change recompiled the monolithic generate program"
+    )
+
+
 def test_top_k_composes_with_top_p():
     """top_k=1 + top_p=1.0 must equal greedy (k filters first, nucleus
     within it — HF semantics), and combined filtering stays in-range."""

@@ -1,0 +1,418 @@
+"""Serving engine (serving/engine.py) battery.
+
+Pins the serving fast path's three contracts against the monolithic
+reference programs (models/decode.generate*_monolithic):
+
+1. bit-equivalence — bucketed prompts, donated/pooled (dirty) caches and
+   the split prefill/decode programs change NOTHING about the tokens, for
+   plain/TP/ZeRO-3 x greedy/fixed-key-sampled x both families;
+2. bounded compilation — a mixed-length, mixed-sampling-config request
+   stream compiles n_buckets prefill programs + ONE decode program, no
+   more (and the legacy monolithic path no longer recompiles per
+   sampling config — satellite of the same PR, tests/test_decode.py);
+3. donation — the KV cache actually aliases in/out of every compiled
+   engine program (the strict mode of the donation audit).
+
+The fast single-case equivalence test runs in tier-1; the full
+composition matrix rides the ``slow`` tier per the PR-1 convention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
+from pytorch_distributed_tpu.models import decode, get_model
+from pytorch_distributed_tpu.serving.engine import (
+    BucketSpec,
+    DecodeEngine,
+)
+
+pytestmark = pytest.mark.full
+
+
+def _cfg(family="gpt2", **kw):
+    extra = {"n_kv_head": 2} if family == "llama" else {}
+    extra.update(kw)
+    return ModelConfig(
+        family=family, vocab_size=97, n_ctx=64, n_embd=64, n_layer=2,
+        n_head=4, dtype="float32", attn_pdrop=0.0, resid_pdrop=0.0,
+        embd_pdrop=0.0, **extra,
+    )
+
+
+def _params_prompt(cfg, batch=2, tp=5, seed=0):
+    params = get_model(cfg).init(jax.random.key(seed), cfg)
+    prompt = jax.random.randint(
+        jax.random.key(seed + 1), (batch, tp), 0, cfg.vocab_size
+    )
+    return params, prompt
+
+
+def test_engine_matches_monolithic_fast():
+    """The tier-1 equivalence pin: bucketed + donated engine output is
+    bit-equal to the legacy one-jit program (greedy AND sampled)."""
+    cfg = _cfg()
+    params, prompt = _params_prompt(cfg)
+    eng = DecodeEngine(
+        cfg, max_len=32, buckets=BucketSpec.powers_of_two(32, min_bucket=8)
+    )
+    ref = decode.generate_monolithic(params, prompt, cfg, 6, max_len=32)
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(params, prompt, 6)), np.asarray(ref)
+    )
+    key = jax.random.key(7)
+    ref_s = decode.generate_monolithic(
+        params, prompt, cfg, 6, max_len=32, temperature=0.9, key=key,
+        top_k=17, top_p=0.95,
+    )
+    got_s = eng.generate(
+        params, prompt, 6, temperature=0.9, key=key, top_k=17, top_p=0.95
+    )
+    np.testing.assert_array_equal(np.asarray(got_s), np.asarray(ref_s))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("sampled", [False, True])
+def test_engine_matches_monolithic_matrix(family, sampled):
+    """Families x greedy/sampled, bucketed engine vs monolithic."""
+    cfg = _cfg(family)
+    params, prompt = _params_prompt(cfg)
+    kw = (
+        dict(temperature=0.8, key=jax.random.key(3), top_p=0.9)
+        if sampled
+        else {}
+    )
+    eng = DecodeEngine(cfg, max_len=32, buckets=BucketSpec((8, 16, 32)))
+    ref = decode.generate_monolithic(
+        params, prompt, cfg, 8, max_len=32, **kw
+    )
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(params, prompt, 8, **kw)), np.asarray(ref)
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("sampled", [False, True])
+def test_engine_tp_matches_monolithic(eight_devices, family, sampled):
+    """TP engine (local-head cache shards, donated) vs the one-jit
+    shard_map reference AND the single-device monolithic program."""
+    cfg = _cfg(family)
+    params, prompt = _params_prompt(cfg)
+    mcfg = MeshConfig(tensor=2)
+    kw = (
+        dict(temperature=1.0, key=jax.random.key(5), top_k=13)
+        if sampled
+        else {}
+    )
+    ref = decode.generate_monolithic(params, prompt, cfg, 8, max_len=16, **kw)
+    ref_tp = decode.generate_tp_monolithic(
+        params, prompt, cfg, mcfg, 8, max_len=16, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(ref_tp), np.asarray(ref))
+    eng = DecodeEngine(
+        cfg, max_len=16, buckets=BucketSpec((8, 16)), mesh_cfg=mcfg
+    )
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(params, prompt, 8, **kw)), np.asarray(ref)
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", ["gpt2", "llama"])
+@pytest.mark.parametrize("prefetch", [0, 1])
+def test_engine_zero3_matches_monolithic(eight_devices, family, prefetch):
+    """ZeRO-3 engine decode (windowed prefetch gathers, donated cache)
+    vs the auto-path one-jit reference — prefetch window on AND off."""
+    cfg = _cfg(family)
+    params, prompt = _params_prompt(cfg)
+    mcfg = MeshConfig(
+        fsdp=2, strategy="full_shard", prefetch_buffers=prefetch
+    )
+    ref = decode.generate_monolithic(params, prompt, cfg, 8, max_len=16)
+    ref_z = decode.generate_fsdp_monolithic(
+        params, prompt, cfg, MeshConfig(fsdp=2), 8, max_len=16
+    )
+    np.testing.assert_array_equal(np.asarray(ref_z), np.asarray(ref))
+    eng = DecodeEngine(
+        cfg, max_len=16, buckets=BucketSpec((8, 16)), mesh_cfg=mcfg
+    )
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(params, prompt, 8)), np.asarray(ref)
+    )
+
+
+def test_bucketed_matches_exact_length():
+    """Padding the prompt to a bucket must not change a single logit's
+    argmax: padded rows are masked out of every attention reduction and
+    overwritten before they become readable."""
+    cfg = _cfg()
+    params, _ = _params_prompt(cfg)
+    exact = DecodeEngine(cfg, max_len=32)  # () buckets = exact lengths
+    bucketed = DecodeEngine(cfg, max_len=32, buckets=BucketSpec((16, 32)))
+    for tp in (3, 9, 15, 16):
+        prompt = jax.random.randint(
+            jax.random.key(tp), (2, tp), 0, cfg.vocab_size
+        )
+        np.testing.assert_array_equal(
+            np.asarray(bucketed.generate(params, prompt, 5)),
+            np.asarray(exact.generate(params, prompt, 5)),
+            err_msg=f"prompt_len={tp}",
+        )
+
+
+def test_dirty_donated_cache_matches_fresh():
+    """The pooled cache buffer is reused DIRTY across requests (donation
+    means it is never re-zeroed); a short request after a long one must
+    match a fresh engine exactly."""
+    cfg = _cfg()
+    params, _ = _params_prompt(cfg)
+    eng = DecodeEngine(cfg, max_len=32, buckets=BucketSpec((16, 32)))
+    long_prompt = jax.random.randint(
+        jax.random.key(1), (2, 14), 0, cfg.vocab_size
+    )
+    eng.generate(params, long_prompt, 10)  # fills cache rows deep
+    short = jax.random.randint(jax.random.key(2), (2, 3), 0, cfg.vocab_size)
+    fresh = DecodeEngine(cfg, max_len=32, buckets=BucketSpec((16, 32)))
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(params, short, 4)),
+        np.asarray(fresh.generate(params, short, 4)),
+    )
+
+
+def test_gqa_bucketed_dirty_cache_no_stale_kv():
+    """GQA edge (n_kv < n_head) under the donated/bucketed cache: the
+    head-repeat in attention must never surface stale K/V written past
+    ``pos`` — neither bucket padding rows nor a previous request's rows
+    left in the reused buffer leak into any reduction."""
+    cfg = _cfg("llama")  # n_kv_head=2 < n_head=4
+    assert cfg.kv_heads < cfg.n_head
+    params, _ = _params_prompt(cfg)
+    eng = DecodeEngine(cfg, max_len=32, buckets=BucketSpec((16, 32)))
+    # Request 1: long + sampled — fills cache rows 0..23 with real K/V.
+    long_prompt = jax.random.randint(
+        jax.random.key(4), (1, 14), 0, cfg.vocab_size
+    )
+    eng.generate(
+        params, long_prompt, 10, temperature=1.0, key=jax.random.key(9)
+    )
+    # Request 2: short prompt, bucket-padded 3 -> 16; rows 3..15 hold pad
+    # garbage and rows beyond hold request 1's K/V. Greedy output must
+    # equal the unpadded, fresh-cache monolithic reference.
+    short = jax.random.randint(jax.random.key(5), (1, 3), 0, cfg.vocab_size)
+    ref = decode.generate_monolithic(params, short, cfg, 6, max_len=32)
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(params, short, 6)), np.asarray(ref)
+    )
+
+
+def test_mixed_stream_compiles_n_buckets_plus_one():
+    """The bounded-compilation contract: >= 8 distinct prompt lengths and
+    >= 2 sampling configs compile exactly n_buckets prefill programs + 1
+    decode program — O(buckets), not O(requests)."""
+    cfg = _cfg()
+    params, _ = _params_prompt(cfg)
+    spec = BucketSpec((8, 16, 24, 32))
+    eng = DecodeEngine(cfg, max_len=48, buckets=spec)
+    lengths = [3, 5, 7, 9, 12, 17, 21, 30]  # 8 distinct, 4 buckets
+    configs = [
+        dict(temperature=0.8, top_k=20),
+        dict(temperature=1.0, top_p=0.9),
+    ]
+    assert len(set(lengths)) >= 8 and len(configs) >= 2
+    key = jax.random.key(0)
+    for i, tp in enumerate(lengths):
+        prompt = jax.random.randint(
+            jax.random.key(i), (1, tp), 0, cfg.vocab_size
+        )
+        eng.generate(params, prompt, 4, key=key, **configs[i % 2])
+    assert eng.compile_count() == len(spec.buckets) + 1, (
+        f"{eng.compile_count()} programs for {len(spec.buckets)} buckets"
+    )
+    # And the whole stream again is compile-free.
+    before = eng.compile_count()
+    for i, tp in enumerate(lengths):
+        prompt = jax.random.randint(
+            jax.random.key(i), (1, tp), 0, cfg.vocab_size
+        )
+        eng.generate(params, prompt, 4, key=key, **configs[(i + 1) % 2])
+    assert eng.compile_count() == before
+
+
+def test_engine_donation_aliases_every_program(audit):
+    """The donation audit (strict mode) proves the KV cache aliases
+    in/out of each compiled engine program — and verify_donation() is the
+    engine's own form of the same check."""
+    from pytorch_distributed_tpu.analysis.budget import NO_COLLECTIVES
+
+    cfg = _cfg()
+    params, _ = _params_prompt(cfg)
+    eng = DecodeEngine(cfg, max_len=16, buckets=BucketSpec((8, 16)))
+    stats = eng.verify_donation(params)
+    for kind in ("prefill", "decode_run", "decode_step"):
+        assert stats[kind]["aliased"] == stats[kind]["expected"] == 2
+        audit.assert_clean(
+            eng.program(kind, sampled=True),
+            eng.example_args(kind, params, sampled=True),
+            NO_COLLECTIVES,
+            donate_argnums=(eng.CACHE_ARGNUM[kind],),
+            donation_strict=True,
+            compute_dtype=cfg.dtype,
+        )
+
+
+def test_stream_matches_generate():
+    """decode_step streaming emits the same tokens as the fused
+    decode_run path (same programs modulo the loop, same key folds)."""
+    cfg = _cfg()
+    params, prompt = _params_prompt(cfg)
+    eng = DecodeEngine(cfg, max_len=32, buckets=BucketSpec((8, 16, 32)))
+    key = jax.random.key(21)
+    ref = eng.generate(params, prompt, 6, temperature=0.7, key=key, top_k=9)
+    toks = list(
+        eng.stream(params, prompt, 6, temperature=0.7, key=key, top_k=9)
+    )
+    assert len(toks) == 6
+    got = jnp.concatenate(
+        [prompt.astype(jnp.int32)] + [t[:, None] for t in toks], axis=1
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_bucket_spec_validation():
+    with pytest.raises(ValueError, match="strictly increasing"):
+        BucketSpec((16, 8))
+    with pytest.raises(ValueError, match="strictly increasing"):
+        BucketSpec((8, 8))
+    with pytest.raises(ValueError, match="exceeds the largest bucket"):
+        BucketSpec((8, 16)).bucket_for(17)
+    assert BucketSpec((8, 16)).bucket_for(9) == 16
+    assert BucketSpec().bucket_for(9) == 9  # exact-length mode
+    assert BucketSpec.powers_of_two(100, min_bucket=16).buckets == (
+        16, 32, 64, 100,
+    )
+
+
+def test_engine_request_validation():
+    cfg = _cfg()
+    params, prompt = _params_prompt(cfg)  # tp=5
+    with pytest.raises(ValueError, match="exceeds n_ctx"):
+        DecodeEngine(cfg, max_len=cfg.n_ctx + 1)
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        DecodeEngine(cfg, max_len=16, buckets=BucketSpec((8, 32)))
+    eng = DecodeEngine(cfg, max_len=16, buckets=BucketSpec((8, 16)))
+    with pytest.raises(ValueError, match="exceeds the engine max_len"):
+        eng.generate(params, prompt, 12)
+    with pytest.raises(ValueError, match="PRNG key"):
+        eng.generate(params, prompt, 4, temperature=0.5)
+    # max_new_tokens=0 returns the prompt unchanged, touching no program.
+    out = eng.generate(params, prompt, 0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+    assert eng.compile_count() == 0
+
+
+def test_top_k_zero_means_disabled_and_negative_rejected():
+    """HF convention: top_k=0 disables the top-k filter (full support) —
+    a traced k=0 would otherwise mask EVERY token and silently degrade
+    to greedy. Pinned: top_k=0 must equal top_k=None for the same key,
+    and differ from greedy on a distribution with competitive tails;
+    negative k fails loudly at the Python boundary."""
+    cfg = _cfg()
+    params, prompt = _params_prompt(cfg)
+    key = jax.random.key(13)
+    none_k = decode.generate_monolithic(
+        params, prompt, cfg, 8, temperature=5.0, key=key
+    )
+    zero_k = decode.generate_monolithic(
+        params, prompt, cfg, 8, temperature=5.0, key=key, top_k=0
+    )
+    np.testing.assert_array_equal(np.asarray(zero_k), np.asarray(none_k))
+    greedy = decode.generate_monolithic(params, prompt, cfg, 8)
+    assert not np.array_equal(np.asarray(zero_k), np.asarray(greedy)), (
+        "top_k=0 at high temperature collapsed to greedy — the "
+        "disabled-filter sentinel regressed"
+    )
+    with pytest.raises(ValueError, match="top_k"):
+        decode.sampling_scalars(1.0, -1, None, cfg.vocab_size)
+
+
+def test_pool_drops_cache_on_failed_dispatch():
+    """A dispatch failure must DROP the in-flight buffer (its donated
+    input is consumed either way — pooling it would hand the next
+    request a deleted array), and the engine must serve the next request
+    correctly from a fresh allocation."""
+    cfg = _cfg()
+    params, prompt = _params_prompt(cfg)
+    eng = DecodeEngine(cfg, max_len=32, buckets=BucketSpec((16, 32)))
+    ref = eng.generate(params, prompt, 5)  # warm; pool holds a buffer
+    assert 2 in eng._cache_pool
+
+    def boom(*a, **k):
+        raise RuntimeError("injected dispatch failure")
+
+    eng._programs[("prefill", False)] = boom
+    with pytest.raises(RuntimeError, match="injected"):
+        eng.generate(params, prompt, 5)
+    assert 2 not in eng._cache_pool  # dropped, not poisoned
+    del eng._programs[("prefill", False)]
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(params, prompt, 5)), np.asarray(ref)
+    )
+
+
+# -- CLI contract: scripts/generate.py --stream -----------------------------
+
+
+def _generate_main(argv, monkeypatch):
+    import importlib.util
+    import sys
+    from pathlib import Path
+
+    scripts = Path(__file__).resolve().parent.parent / "scripts"
+    monkeypatch.syspath_prepend(str(scripts))
+    spec = importlib.util.spec_from_file_location(
+        "_generate_cli_serving", scripts / "generate.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(sys, "argv", ["generate.py"] + argv)
+    return mod.main()
+
+
+def test_generate_cli_stream_rejects_speculative(monkeypatch):
+    """--stream drives the per-token decode_step API; --speculative
+    commits a variable number of tokens per program — the combination
+    must SystemExit up front, not silently pick one."""
+    with pytest.raises(SystemExit, match="cannot stream"):
+        _generate_main(
+            ["--preset", "tiny", "--speculative", "4", "--stream"],
+            monkeypatch,
+        )
+
+
+def test_generate_cli_stream_matches_batch_output(monkeypatch, capsys):
+    """--stream end-to-end: the streamed token ids equal the generated
+    tail of the one-shot CLI run (same seed, greedy, random init)."""
+    base = ["--preset", "tiny", "--prompt-ids", "1,2,3",
+            "--max-new-tokens", "5", "--seed", "3"]
+    assert _generate_main(base, monkeypatch) == 0
+    full = capsys.readouterr().out.strip().split(",")
+    assert _generate_main(base + ["--stream"], monkeypatch) == 0
+    streamed = capsys.readouterr().out.strip().split(",")
+    assert streamed == full[3:]  # the generated tail, token for token
+
+
+@pytest.mark.slow
+def test_engine_moe_matches_monolithic():
+    """MoE decode through the engine (routing is per-token and
+    cache-free, so the donated cache discipline is unchanged)."""
+    cfg = _cfg("gpt2", n_experts=4, expert_capacity_factor=8.0)
+    params, prompt = _params_prompt(cfg)
+    eng = DecodeEngine(cfg, max_len=32, buckets=BucketSpec((16, 32)))
+    ref = decode.generate_monolithic(params, prompt, cfg, 6, max_len=32)
+    np.testing.assert_array_equal(
+        np.asarray(eng.generate(params, prompt, 6)), np.asarray(ref)
+    )

@@ -53,6 +53,32 @@ def test_speculative_equals_greedy_repetitive_prompt(family):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
 
 
+def test_speculative_matches_monolithic_and_engine_greedy():
+    """The jit-internal-cache decision pin (see models/speculative.py
+    "Why the KV cache stays jit-internal"): the speculative loop must
+    stay loss/token-equivalent to BOTH greedy references — the
+    monolithic one-jit path and the serving engine's donated-cache
+    path — so the decision not to route its verify step through the
+    engine cannot silently cost correctness."""
+    from pytorch_distributed_tpu.serving.engine import (
+        BucketSpec,
+        DecodeEngine,
+    )
+
+    cfg = _cfg("gpt2")
+    params = get_model(cfg).init(jax.random.key(6), cfg)
+    prompt = jax.random.randint(jax.random.key(7), (1, 6), 0, cfg.vocab_size)
+    spec = generate_speculative(params, prompt, cfg, 16)
+    mono = decode.generate_monolithic(params, prompt, cfg, 16)
+    eng = DecodeEngine(
+        cfg, max_len=prompt.shape[1] + 16, buckets=BucketSpec((8,))
+    )
+    np.testing.assert_array_equal(np.asarray(spec), np.asarray(mono))
+    np.testing.assert_array_equal(
+        np.asarray(spec), np.asarray(eng.generate(params, prompt, 16))
+    )
+
+
 @pytest.mark.parametrize("draft_len,ngram", [(1, 1), (4, 2), (8, 3)])
 def test_speculative_settings_do_not_change_output(draft_len, ngram):
     cfg = _cfg("gpt2")
