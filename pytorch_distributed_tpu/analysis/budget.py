@@ -105,6 +105,15 @@ STABLE_MAX_COUNTS: dict[str, dict[str, int]] = {
     },
     "zero2_bucketed": {"reduce-scatter": 2, "all-reduce": 18},
     "zero3_decode_prefetch": {"all-gather": 28, "all-reduce": 11},
+    # Slot-batched TP decode step (serving/engine.BatchedDecodeEngine):
+    # exactly the scanned block body's two Megatron psums (attention
+    # c_proj + MLP c_proj), emitted ONCE each thanks to the layer scan —
+    # and, because every per-row quantity (pos, fold, sampling params,
+    # active pattern) is a traced operand, this count is INVARIANT to how
+    # many rows are active: admissions/retirements never touch the
+    # program. Growth means per-row handling leaked a collective (e.g.
+    # sampling started psumming per row) or the scan unrolled.
+    "decode_batched_step_tp": {"all-reduce": 2},
 }
 
 

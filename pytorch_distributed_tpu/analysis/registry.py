@@ -174,6 +174,44 @@ def _build_decode_engine(
     }
 
 
+def _build_batched_engine(
+    kind: str,
+    mesh_cfg: MeshConfig | None = None,
+    budget: CollectiveBudget | None = NO_COLLECTIVES,
+    budget_case: str | None = None,
+):
+    """A slot-batched serving program (serving/engine.BatchedDecodeEngine):
+    the EXACT jitted prefill / decode_step the scheduler dispatches. All
+    per-row state (pos, fold counters, sampling params, keys) is traced,
+    so ONE executable covers every admission/retirement pattern — which is
+    also why the pinned collective counts are invariant to how many rows
+    are active: activity never reaches the program. Audited with
+    ``donation_strict`` (a rejected alias would double-buffer the whole
+    (slots, max_len) cache every token)."""
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.serving.engine import (
+        BatchedDecodeEngine,
+        BucketSpec,
+    )
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _tiny()
+    params = get_model(cfg).init(domain_key(42, "init"), cfg)
+    engine = BatchedDecodeEngine(
+        cfg, slots=4, max_len=16, buckets=BucketSpec((8, 16)),
+        mesh_cfg=mesh_cfg,
+    )
+    fn = engine.program(kind)
+    args = engine.example_args(kind, engine._place_params(params))
+    if budget_case is not None:
+        budget = pin_max_counts(budget, budget_case)
+    return fn, args, budget, {
+        "compute_dtype": cfg.dtype,
+        "donate_argnums": (engine.CACHE_ARGNUM[kind],),
+        "donation_strict": True,
+    }
+
+
 def _build_pipeline(schedule: str):
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.parallel import make_mesh
@@ -385,6 +423,47 @@ def registered_cases() -> dict[str, AuditCase]:
                 ),
                 budget_case="zero3_decode_prefetch",
                 async_min_compute=1,
+            ),
+        ),
+        # Slot-batched serving programs (continuous batching): per-row
+        # positions/sampling are traced, so one executable serves every
+        # admission/retirement pattern — collective counts CANNOT depend
+        # on how many rows are active (pinned for the TP case).
+        AuditCase(
+            "decode_batched_prefill",
+            "slot-batched prefill (gather rows -> forward -> scatter "
+            "back, donated slot cache): single device, any collective "
+            "is a bug",
+            1,
+            lambda: _build_batched_engine("prefill"),
+        ),
+        AuditCase(
+            "decode_batched_step",
+            "slot-batched decode step (per-row pos/sampling, donated "
+            "slot cache): single device, any collective is a bug",
+            1,
+            lambda: _build_batched_engine("decode_step"),
+        ),
+        AuditCase(
+            "decode_batched_step_tp",
+            "slot-batched decode step over tensor=4 (head-sharded slot "
+            "cache, Megatron psums; max_counts pinned — invariant to "
+            "active-row count by construction)",
+            4,
+            lambda: _build_batched_engine(
+                "decode_step",
+                mesh_cfg=MeshConfig(tensor=4, strategy="no_shard"),
+                budget=CollectiveBudget(
+                    required={"all-reduce"},
+                    forbidden={
+                        "all-gather", "reduce-scatter", "all-to-all",
+                        "collective-permute",
+                    },
+                    note="Megatron decode: psum at parallel-region "
+                         "boundaries + replicated-logits reductions; "
+                         "nothing else has any business here",
+                ),
+                budget_case="decode_batched_step_tp",
             ),
         ),
         # pjit twins of the explicit cases (parallel/api.py). Budgets per

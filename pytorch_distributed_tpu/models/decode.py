@@ -81,7 +81,11 @@ def init_cache(
 
 def _cached_attention(q, ck, cv, pos):
     """q [B, T, H, D] against the full cache [B, S, Hkv, D]; queries sit at
-    global positions pos..pos+T-1, keys j are valid iff j <= pos + i."""
+    global positions pos..pos+T-1, keys j are valid iff j <= pos + i.
+    ``pos`` is a scalar (every row at the same position — the single-request
+    paths) or a [B] vector (slot-batched decode: each row carries its own
+    position, so each row's mask — and therefore which cache rows it can
+    ever read — is independent of its neighbours)."""
     b, t, h, d = q.shape
     s, hkv = ck.shape[1], ck.shape[2]
     if hkv != h:
@@ -91,18 +95,29 @@ def _cached_attention(q, ck, cv, pos):
     scores = jnp.einsum(
         "bthd,bshd->bhts", q, ck, preferred_element_type=jnp.float32
     ) / (d**0.5)
-    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
     kpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1)
-    scores = jnp.where(kpos <= qpos, scores, -1e30)
+    if getattr(pos, "ndim", 0):  # per-row positions -> [B, 1, T, S] mask
+        valid = kpos[None] <= pos[:, None, None] + qpos[None]
+        scores = jnp.where(valid[:, None], scores, -1e30)
+    else:
+        scores = jnp.where(kpos <= pos + qpos, scores, -1e30)
     w = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
     return jnp.einsum("bhts,bshd->bthd", w, cv)
 
 
 def _write(cache_layer, new, pos):
-    """Insert new [B, T, Hkv, D] at time offset pos."""
-    return jax.lax.dynamic_update_slice(
-        cache_layer, new.astype(cache_layer.dtype), (0, pos, 0, 0)
-    )
+    """Insert new [B, T, Hkv, D] at time offset pos. A [B] vector pos
+    writes each row at ITS OWN offset (slot-batched decode) via a vmapped
+    per-row update — pure data movement either way, so a row written at
+    pos[b] holds bit-identical values to the scalar-pos write at the same
+    offset."""
+    new = new.astype(cache_layer.dtype)
+    if getattr(pos, "ndim", 0):
+        return jax.vmap(
+            lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
+        )(cache_layer, new, pos)
+    return jax.lax.dynamic_update_slice(cache_layer, new, (0, pos, 0, 0))
 
 
 def _moe_mlp(m, mlp_params, cfg, act, tensor_axis=None):
@@ -173,7 +188,7 @@ def forward(
     input_ids: jax.Array,  # [B, T] — full prompt (prefill) or one token
     cfg: ModelConfig,
     cache: Cache,
-    pos: jax.Array | int,  # tokens already in the cache
+    pos: jax.Array | int,  # tokens already in the cache (scalar or [B])
     *,
     tensor_axis: str | None = None,
     block_transform=None,
@@ -183,6 +198,14 @@ def forward(
     updated cache). MoE configs route each token through the expert MLPs
     (no-drop capacity — see ``_moe_mlp``); routing is stateless, so the
     KV cache is untouched by the choice of MLP.
+
+    ``pos`` may be a [B] VECTOR: each batch row then runs at its own
+    position (cache write offset, attention mask, wpe/rope angles) — the
+    slot-batched decode mode (serving/engine.BatchedDecodeEngine), where
+    independent requests occupy rows of one program at unrelated depths.
+    Row b's computation is bit-identical to the scalar-pos call at
+    pos[b] with that row alone (pure per-row data movement + the same
+    per-row reductions).
 
     ``tensor_axis``: set when called inside shard_map with block params
     sharded Megatron-style (tensor-parallel decode): attention runs on
@@ -199,15 +222,21 @@ def forward(
     b, t = input_ids.shape
     dtype = jnp.dtype(cfg.dtype)
     pos = jnp.asarray(pos, jnp.int32)
+    per_row = pos.ndim > 0  # [B] vector: slot-batched, per-row positions
 
     if cfg.family == "gpt2":
-        wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, t, axis=0)
+        if per_row:
+            rows = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+            wpe = params["wpe"][rows]  # [B, T, E], row b at its own pos
+        else:
+            wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, t, axis=0)
         x = (params["wte"][input_ids] + wpe).astype(dtype)
         block = partial(_gpt2_block, cfg=cfg, tensor_axis=tensor_axis)
     elif cfg.family == "llama":
         x = params["wte"][input_ids].astype(dtype)
         cos, sin = rope_angles(
-            t, cfg.head_dim, cfg.rope_theta, offset=pos
+            t, cfg.head_dim, cfg.rope_theta,
+            offset=pos[:, None] if per_row else pos,
         )
         block = partial(
             _llama_block, cfg=cfg, cos=cos, sin=sin,
@@ -310,6 +339,30 @@ def sample_token(logits, sampled: bool, temperature, key, top_k, top_p):
     if not sampled:
         return _sample_greedy(logits)
     return _sample_traced(logits, temperature, key, top_k, top_p)
+
+
+def sample_token_rows(logits, greedy, temperature, keys, top_k, top_p):
+    """One next-token draw PER ROW with fully per-row sampling state:
+    ``logits`` [B, V]; ``greedy`` [B] bool plus ``temperature``/``top_k``/
+    ``top_p`` [B] — all TRACED, so a slot batch can mix greedy and sampled
+    requests with any configs in one compiled program; ``keys`` [B] typed
+    PRNG keys (one per request, already folded to the row's step).
+
+    Row r's draw is bit-identical to the serial path's
+    ``sample_token(logits[r:r+1], ...)`` with the same key: the sampled
+    branch IS the B=1 ``_sample_traced`` body vmapped over rows (vmap of
+    threefry is elementwise in (key, counter), so the drawn bits match the
+    individual calls), and greedy rows select the same argmax. Unlike the
+    serial engine's static greedy/sampled split, greedy here is a traced
+    flag — the batch must serve both kinds of row in one program, so the
+    sort always runs and greedy rows discard the draw (the price of one
+    program for every traffic mix)."""
+
+    def row(l, g, t, key, k, p):
+        drawn = _sample_traced(l[None], t, key, k, p)[0]
+        return jnp.where(g, _sample_greedy(l[None])[0], drawn)
+
+    return jax.vmap(row)(logits, greedy, temperature, keys, top_k, top_p)
 
 
 def _generate_impl(
@@ -567,6 +620,11 @@ def _check_sample_args(prompt, max_new_tokens, temperature, key):
     generate — the write of the first sampled token would statically index
     out of bounds); otherwise ``key`` is non-None (greedy paths get a
     dummy, unused by sampling)."""
+    if prompt.shape[-1] == 0:
+        raise ValueError(
+            "empty prompt: need at least one token to prefill (an empty "
+            "prompt would sample the first token from a pad position)"
+        )
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     if max_new_tokens == 0:

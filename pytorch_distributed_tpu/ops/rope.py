@@ -16,14 +16,18 @@ def rope_angles(
     seq_len: int, head_dim: int, theta: float, *, offset=0
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (cos, sin), each [seq_len, head_dim] float32. ``offset`` may be
-    a traced scalar (e.g. a sequence-shard start under context parallelism)."""
+    a traced scalar (e.g. a sequence-shard start under context parallelism)
+    or a [B, 1] per-row column (slot-batched decode, where every batch row
+    sits at its own position): broadcasting then yields [B, seq_len,
+    head_dim] angles whose row b equals the scalar-offset result for
+    offset[b]."""
     half = head_dim // 2
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, half, dtype=jnp.float32) * 2.0 / head_dim)
     )
-    pos = jnp.arange(seq_len, dtype=jnp.float32) + offset
-    angles = jnp.outer(pos, inv_freq)  # [T, half]
-    angles = jnp.concatenate([angles, angles], axis=-1)  # [T, D]
+    pos = jnp.arange(seq_len, dtype=jnp.float32) + offset  # [T] or [B, T]
+    angles = pos[..., None] * inv_freq  # [..., T, half]
+    angles = jnp.concatenate([angles, angles], axis=-1)  # [..., T, D]
     return jnp.cos(angles), jnp.sin(angles)
 
 
@@ -35,11 +39,15 @@ def _rotate_half(x: jax.Array) -> jax.Array:
 
 def apply_rope(
     x: jax.Array,  # [B, T, H, D]
-    cos: jax.Array,  # [T, D]
-    sin: jax.Array,  # [T, D]
+    cos: jax.Array,  # [T, D] shared, or [B, T, D] per-row angles
+    sin: jax.Array,
 ) -> jax.Array:
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 3:  # per-row positions (slot-batched decode)
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+    else:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
     return (x32 * c + _rotate_half(x32) * s).astype(dtype)

@@ -1,6 +1,9 @@
-"""Serving fast path: the persistent donated-KV decode engine."""
+"""Serving fast path: the persistent donated-KV decode engines (serial
+per-request DecodeEngine + slot-scheduled continuous-batching
+BatchedDecodeEngine)."""
 
 from pytorch_distributed_tpu.serving.engine import (  # noqa: F401
+    BatchedDecodeEngine,
     BucketSpec,
     DecodeEngine,
     shim_engine,

@@ -47,6 +47,19 @@ Modes (one engine per mode x config):
   sharded over the head dim — 1/tp of the cache HBM per chip).
 - zero3 (``mesh_cfg.fsdp`` > 1, full_shard): auto-partitioned decode in
   the ZeRO-3 training layout with the windowed gather schedule above.
+TP x ZeRO-3 mixed meshes are rejected up front with a diagnostic naming
+these modes (``_reject_tp_zero3_mix``); native composition is future
+surface.
+
+Two engines share this machinery:
+- ``DecodeEngine`` — serial: one request (of any batch) at a time, with
+  an LRU-BOUNDED dirty-cache pool across requests.
+- ``BatchedDecodeEngine`` — continuous batching: a fixed pool of slot
+  ROWS inside one (slots, max_len) cache, a host-side scheduler that
+  admits/retires requests per row, per-row traced positions and sampling
+  state, and ONE compiled decode step advancing every row per dispatch.
+  See its class docstring; this is the engine that fills the batch
+  dimension under real multi-tenant traffic.
 
 Outputs are bit-equal to the monolithic reference paths for identical
 requests (greedy and fixed-key sampled) — same forward, same sampler,
@@ -60,17 +73,62 @@ engines per worker).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 from pytorch_distributed_tpu.models import decode
 
 _PROGRAM_KINDS = ("prefill", "decode_run", "decode_step")
+_BATCHED_PROGRAM_KINDS = ("prefill", "decode_step")
+
+
+def _reject_tp_zero3_mix(mesh_cfg: MeshConfig | None, entry: str) -> None:
+    """Both serving entry points reject the TP x ZeRO-3 mixed mesh with
+    one diagnostic naming the supported modes (ROADMAP serving follow-up
+    (c)): decoding from a mixed layout needs each gathered layer window
+    re-split over the tensor axis inside the token loop — a schedule
+    neither the shard_map TP path nor the auto-partitioned ZeRO-3 path
+    expresses today. Full composition is future surface."""
+    if mesh_cfg is not None and mesh_cfg.tensor > 1 and mesh_cfg.fsdp > 1:
+        raise NotImplementedError(
+            f"{entry} does not support TP x ZeRO-3 mixed-mesh decode "
+            f"(got tensor={mesh_cfg.tensor}, fsdp={mesh_cfg.fsdp}). "
+            "Supported modes: plain (single device / no mesh), tp "
+            "(tensor-only mesh, Megatron layouts with a head-sharded KV "
+            "cache), and zero3 (fsdp-only full_shard mesh, DecodeEngine "
+            "only). Serve a mixed-mesh checkpoint by resharding to one "
+            "of those layouts; native composition is a future PR."
+        )
+
+
+def _select_mode(
+    cfg: ModelConfig, mesh_cfg: MeshConfig | None, *,
+    entry: str, allow_zero3: bool = True,
+):
+    """Shared engine mode selection: (mode, mesh_cfg, n_kv,
+    prefetch_buffers), with the mixed-mesh rejection applied first so
+    both engines emit the same diagnostic."""
+    _reject_tp_zero3_mix(mesh_cfg, entry)
+    if mesh_cfg is None or mesh_cfg.num_devices == 1:
+        return "plain", None, None, 0
+    if mesh_cfg.tensor > 1:
+        decode._validate_tp_mesh(cfg, mesh_cfg)
+        return "tp", mesh_cfg, cfg.kv_heads // mesh_cfg.tensor, 0
+    if not allow_zero3:
+        raise NotImplementedError(
+            f"{entry} supports plain and tp modes; ZeRO-3 slot-batched "
+            "decode is future surface — serve ZeRO-3 layouts through "
+            "DecodeEngine, or decode from a tensor-only mesh"
+        )
+    decode._validate_fsdp_mesh(mesh_cfg)
+    return "zero3", mesh_cfg, None, mesh_cfg.prefetch_buffers
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,6 +191,7 @@ class DecodeEngine:
         buckets: BucketSpec | None = None,
         mesh_cfg: MeshConfig | None = None,
         pool_caches: bool = True,
+        pool_max_entries: int = 8,
     ) -> None:
         if max_len > cfg.n_ctx:
             raise ValueError(
@@ -146,20 +205,9 @@ class DecodeEngine:
                 f"largest bucket {self.buckets.buckets[-1]} exceeds "
                 f"max_len {max_len}"
             )
-        self.mesh_cfg = mesh_cfg
-        self._n_kv = None
-        self._prefetch_buffers = 0
-        if mesh_cfg is None or mesh_cfg.num_devices == 1:
-            self.mode = "plain"
-            self.mesh_cfg = None
-        elif mesh_cfg.tensor > 1:
-            decode._validate_tp_mesh(cfg, mesh_cfg)
-            self.mode = "tp"
-            self._n_kv = cfg.kv_heads // mesh_cfg.tensor
-        else:
-            decode._validate_fsdp_mesh(mesh_cfg)
-            self.mode = "zero3"
-            self._prefetch_buffers = mesh_cfg.prefetch_buffers
+        self.mode, self.mesh_cfg, self._n_kv, self._prefetch_buffers = (
+            _select_mode(cfg, mesh_cfg, entry="DecodeEngine")
+        )
         if self.mode != "plain":
             (
                 self._mesh, self._p_specs, self._param_shardings
@@ -174,7 +222,18 @@ class DecodeEngine:
         # lives forever in shim_engine's cache, so pooling there would
         # pin one full-size cache per distinct request shape; a real
         # serving deployment constructs ONE engine and wants the pool.
+        # The pool is LRU-BOUNDED at pool_max_entries distinct batch
+        # shapes (ROADMAP serving follow-up (d)): a traffic mix cycling
+        # through many batch sizes caps pooled-cache HBM at
+        # pool_max_entries x max_len-cache bytes instead of growing with
+        # shape diversity; the least-recently-returned shape is dropped
+        # (freed by the allocator once the array is unreferenced).
         self._pool_caches = pool_caches
+        if pool_max_entries < 1:
+            raise ValueError(
+                f"pool_max_entries must be >= 1, got {pool_max_entries}"
+            )
+        self._pool_max = int(pool_max_entries)
         self._cache_pool: dict[int, decode.Cache] = {}
 
     # -- cache pool --------------------------------------------------------
@@ -196,8 +255,14 @@ class DecodeEngine:
         return self._cache_pool.pop(batch, None) or self.new_cache(batch)
 
     def _return_cache(self, batch: int, cache: decode.Cache) -> None:
-        if self._pool_caches:
-            self._cache_pool[batch] = cache
+        if not self._pool_caches:
+            return
+        # Most-recently-used at the end (dict preserves insertion order);
+        # evict from the front once the pool exceeds its LRU bound.
+        self._cache_pool.pop(batch, None)
+        self._cache_pool[batch] = cache
+        while len(self._cache_pool) > self._pool_max:
+            self._cache_pool.pop(next(iter(self._cache_pool)))
 
     def _cache_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -540,6 +605,635 @@ class DecodeEngine:
                     f"engine program {kind!r} ({self.mode}): donated KV "
                     "cache does not fully alias in the compiled "
                     f"executable — {findings[0].message}"
+                )
+        return stats_all
+
+
+@dataclasses.dataclass
+class _Pending:
+    """A queued request (host-side): everything the prefill dispatch
+    needs, encoded once at submit time."""
+
+    rid: int
+    prompt: np.ndarray  # [Tp] int32
+    bucket: int
+    max_new: int
+    eos_id: int | None
+    greedy: bool
+    t: float
+    k: int
+    p: float
+    keydata: np.ndarray  # key-impl uint32 words
+
+
+@dataclasses.dataclass
+class _Slot:
+    """One occupied row of the slot batch (host-side scheduler state)."""
+
+    rid: int
+    prompt: np.ndarray
+    max_new: int
+    eos_id: int | None
+    pos: int  # tokens in the row's cache = next KV write offset
+    fold: int  # fold_in counter for the row's NEXT sampled draw
+    generated: list
+    greedy: bool
+    t: float
+    k: int
+    p: float
+    keydata: np.ndarray
+
+
+class BatchedDecodeEngine:
+    """Continuous batching: slot-scheduled multi-request decode.
+
+    ``DecodeEngine`` serves one request shape at a time — under real
+    traffic the batch dimension idles while requests queue. This engine
+    keeps ONE long-lived ``(slots, max_len)`` KV cache whose rows are
+    independent requests at unrelated depths: a host-side scheduler
+    admits queued prompts into free rows (bucketed per-row prefill, or
+    one batched prefill when several arrivals share a bucket), a single
+    compiled ``decode_step`` advances ALL rows one token per dispatch,
+    and finished rows retire without touching their neighbours. Every
+    per-row quantity — position, fold counter, greedy flag,
+    temperature/top_k/top_p, PRNG key — is a TRACED [slots] operand, so
+    admissions, retirements, sampling-config changes, and any
+    active-row pattern reuse the same executables: steady-state serving
+    is zero-recompile BY CONSTRUCTION (shapes never change — the pjit
+    fixed-shape compilation discipline), and the collective count of the
+    TP program is invariant to how many rows are active (pinned in the
+    audit registry).
+
+    Soundness of row reuse is the PR-4 dirty-cache discipline at ROW
+    granularity: a retired row's K/V stays in place; the next admission
+    prefills over it, and per-row masking (``decode._cached_attention``
+    with a [B] pos vector) guarantees no row ever reads cache positions
+    past its own write point — including the GQA head-repeat edge
+    (tests/test_serving_batched.py).
+
+    The decode program is deliberately OBLIVIOUS to which rows are
+    active: free rows compute garbage that the host discards. Gating
+    them with a mask would save nothing (the shapes are fixed) and would
+    make program behaviour depend on activity — exactly what the
+    zero-recompile and collective-count contracts forbid. ``active`` is
+    therefore host-side scheduler state, not a program operand.
+
+    Modes: plain and tp (head-sharded global cache — 1/tp of the cache
+    HBM per chip). ZeRO-3 slot batching and TP x ZeRO-3 stay rejected
+    with explicit diagnostics (``_select_mode``). MoE configs are
+    rejected: expert capacity couples rows through the dispatch (a busy
+    neighbour could evict a row's tokens), breaking the per-row
+    independence this engine is built on.
+
+    Unlike the serial engine there is no greedy/sampled program split:
+    one batch serves both kinds of row, so greedy is a traced per-row
+    flag and the full-vocab sort always runs (see
+    ``decode.sample_token_rows``). Program count: ONE decode_step shape
+    + (buckets x prefill group sizes) prefill shapes — compile_count()
+    is asserted flat across admit/retire churn in tests.
+
+    Not thread-safe (single dispatcher per engine); requests are
+    single-sequence (one row each — batch your own beams as separate
+    requests).
+    """
+
+    # The donated cache's positional index in each program signature.
+    CACHE_ARGNUM = {"prefill": 4, "decode_step": 2}
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        *,
+        slots: int,
+        max_len: int,
+        buckets: BucketSpec | None = None,
+        mesh_cfg: MeshConfig | None = None,
+        prefill_groups: tuple[int, ...] | None = None,
+    ) -> None:
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_len > cfg.n_ctx:
+            raise ValueError(f"max_len {max_len} exceeds n_ctx {cfg.n_ctx}")
+        if cfg.n_experts:
+            raise NotImplementedError(
+                "BatchedDecodeEngine does not serve MoE configs: expert "
+                "capacity couples batch rows through the dispatch, so a "
+                "row's output would depend on its neighbours — use the "
+                "serial DecodeEngine for MoE decode"
+            )
+        self.cfg = cfg
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.buckets = buckets or BucketSpec()
+        if self.buckets.buckets and self.buckets.buckets[-1] > max_len:
+            raise ValueError(
+                f"largest bucket {self.buckets.buckets[-1]} exceeds "
+                f"max_len {max_len}"
+            )
+        if prefill_groups is None:
+            # Powers of two up to the slot count: a burst of n same-bucket
+            # arrivals pads to the next group size, so prefill compiles
+            # O(buckets x log slots) shapes, not O(buckets x slots).
+            groups = []
+            g = 1
+            while g < self.slots:
+                groups.append(g)
+                g *= 2
+            groups.append(self.slots)
+            prefill_groups = tuple(groups)
+        pg = tuple(sorted(set(int(g) for g in prefill_groups)))
+        if not pg or pg[0] < 1 or pg[-1] < self.slots:
+            raise ValueError(
+                f"prefill_groups must be positive and cover the slot "
+                f"count {self.slots}, got {prefill_groups}"
+            )
+        self._groups = pg
+        self.mode, self.mesh_cfg, self._n_kv, _ = _select_mode(
+            cfg, mesh_cfg, entry="BatchedDecodeEngine", allow_zero3=False
+        )
+        if self.mode == "tp":
+            (
+                self._mesh, self._p_specs, self._param_shardings
+            ) = decode._mesh_param_shardings(cfg, self.mesh_cfg)
+        self._programs: dict[str, Any] = {}
+        # ONE cache for the engine's whole life, donated through every
+        # dispatch — HBM is bounded at exactly one (slots, max_len) cache
+        # by construction (no pool to bound). None = not yet allocated,
+        # or dropped after a failed dispatch (the donated input is
+        # consumed either way; the next dispatch re-allocates zeros and
+        # per-row masking makes the lost garbage irrelevant — but the
+        # in-flight rows lost their K/V, so a failure aborts them).
+        self._cache: decode.Cache | None = None
+        self._key_words = np.asarray(
+            jax.random.key_data(jax.random.key(0))
+        ).shape[-1]
+        self._queue: collections.deque[_Pending] = collections.deque()
+        self._slots: list[_Slot | None] = [None] * self.slots
+        self._next_rid = 0
+        # (source tree, placed tree): _place_params runs once per
+        # scheduler tick — one jax.device_put tree traversal per TOKEN
+        # without this identity memo (the serial engine pays it once per
+        # request; holding the source keeps its id from being recycled).
+        self._placed: tuple[Any, Any] | None = None
+        self.results: dict[int, np.ndarray] = {}
+        self.aborted: set[int] = set()
+
+    # -- cache -------------------------------------------------------------
+
+    def _new_cache(self) -> decode.Cache:
+        if self.mode == "tp":
+            full = decode.init_cache(self.cfg, self.slots, self.max_len)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = P(None, None, None, "tensor", None)
+            sharding = jax.tree.map(
+                lambda s: NamedSharding(self._mesh, s),
+                {"k": spec, "v": spec},
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            return jax.device_put(full, sharding)
+        return decode.init_cache(
+            self.cfg, self.slots, self.max_len, n_kv=self._n_kv
+        )
+
+    def _take_cache(self) -> decode.Cache:
+        cache, self._cache = self._cache, None
+        return cache if cache is not None else self._new_cache()
+
+    # -- programs ----------------------------------------------------------
+
+    def _forward(self, params, ids, cache, pos):
+        kwargs = {}
+        if self.mode == "tp":
+            kwargs["tensor_axis"] = "tensor"
+        return decode.forward(params, ids, self.cfg, cache, pos, **kwargs)
+
+    def _bodies(self):
+        """The two raw program bodies. All sampling state is per-row and
+        traced; ``rows``/``pos``/``folds`` are traced index vectors, so
+        one compiled shape covers every admission/retirement pattern."""
+
+        def prefill(params, prompts, plens, rows, cache,
+                    greedy, t, k, p, keydata):
+            # Gather the target rows' (dirty) segments, run the normal
+            # prefill forward over them at pos 0, scatter back. Padded
+            # group entries duplicate row index AND data, so the
+            # overlapping scatter writes are identical (deterministic).
+            seg = {kk: vv[:, rows] for kk, vv in cache.items()}
+            logits, seg = self._forward(params, prompts, seg, 0)
+            last = jnp.take_along_axis(
+                logits, (plens - 1)[:, None, None], axis=1
+            )[:, 0]
+            keys = jax.random.wrap_key_data(keydata)
+            tok = decode.sample_token_rows(last, greedy, t, keys, k, p)
+            cache = {
+                kk: cache[kk].at[:, rows].set(seg[kk]) for kk in cache
+            }
+            return tok, cache
+
+        def decode_step(params, toks, cache, pos, folds,
+                        greedy, t, k, p, keydata):
+            logits, cache = self._forward(params, toks[:, None], cache, pos)
+            keys = jax.vmap(jax.random.fold_in)(
+                jax.random.wrap_key_data(keydata), folds
+            )
+            tok = decode.sample_token_rows(
+                logits[:, -1], greedy, t, keys, k, p
+            )
+            return tok, cache
+
+        return {"prefill": prefill, "decode_step": decode_step}
+
+    def program(self, kind: str):
+        """The jitted program for ``kind`` — public for the audit
+        registry (analysis/registry.py) and tests, like
+        ``DecodeEngine.program``."""
+        if kind not in _BATCHED_PROGRAM_KINDS:
+            raise KeyError(f"unknown batched program kind {kind!r}")
+        prog = self._programs.get(kind)
+        if prog is not None:
+            return prog
+        body = self._bodies()[kind]
+        donate = (self.CACHE_ARGNUM[kind],)
+        if self.mode == "plain":
+            prog = jax.jit(body, donate_argnums=donate)
+        else:  # tp
+            from jax.sharding import PartitionSpec as P
+
+            from pytorch_distributed_tpu.utils.compat import shard_map
+
+            cache_spec = {
+                "k": P(None, None, None, "tensor", None),
+                "v": P(None, None, None, "tensor", None),
+            }
+            specs = {
+                "prefill": (
+                    self._p_specs, P(), P(), P(), cache_spec,
+                    P(), P(), P(), P(), P(),
+                ),
+                "decode_step": (
+                    self._p_specs, P(), cache_spec, P(), P(),
+                    P(), P(), P(), P(), P(),
+                ),
+            }[kind]
+            smapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=specs,
+                out_specs=(P(), cache_spec),
+                check_vma=True,
+            )
+            prog = jax.jit(smapped, donate_argnums=donate)
+        self._programs[kind] = prog
+        return prog
+
+    def _place_params(self, params):
+        if self.mode == "plain":
+            return params
+        if self._placed is None or self._placed[0] is not params:
+            self._placed = (
+                params, jax.device_put(params, self._param_shardings)
+            )
+        return self._placed[1]
+
+    # -- request API -------------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        max_new_tokens: int,
+        *,
+        temperature: float = 0.0,
+        key: jax.Array | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        eos_id: int | None = None,
+    ) -> int:
+        """Queue one single-sequence request ([Tp] or [1, Tp] int ids);
+        returns its request id. The request is admitted into a free slot
+        by a later ``step``; its output (prompt + generated ids, cut at
+        ``eos_id`` if hit) lands in ``self.results[rid]`` — collect it
+        with ``pop_result(rid)`` (long-lived engines leak host memory
+        otherwise). Backpressure is the queue itself: submissions beyond
+        the slot count simply wait their FIFO turn."""
+        prompt = np.asarray(prompt)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1:
+            raise ValueError(
+                f"BatchedDecodeEngine serves one sequence per request "
+                f"(one slot row); got prompt shape {prompt.shape}"
+            )
+        tp = prompt.shape[0]
+        if tp == 0:
+            raise ValueError(
+                "empty prompt: need at least one token to prefill (an "
+                "empty prompt would sample the first token from a pad "
+                "position's logits)"
+            )
+        if max_new_tokens < 0:
+            raise ValueError(
+                f"max_new_tokens must be >= 0, got {max_new_tokens}"
+            )
+        if tp + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({tp}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the engine max_len {self.max_len}"
+            )
+        if temperature > 0.0 and key is None:
+            raise ValueError("temperature sampling requires a PRNG key")
+        rid = self._next_rid
+        self._next_rid += 1
+        if max_new_tokens == 0:
+            self.results[rid] = prompt.astype(np.int32)
+            return rid
+        bucket = self.buckets.bucket_for(tp)
+        t, k, p = decode.sampling_scalars(
+            temperature, top_k, top_p, self.cfg.vocab_size
+        )
+        keydata = (
+            np.asarray(jax.random.key_data(key))
+            if key is not None
+            else np.zeros((self._key_words,), np.uint32)
+        )
+        self._queue.append(_Pending(
+            rid=rid, prompt=prompt.astype(np.int32), bucket=bucket,
+            max_new=int(max_new_tokens), eos_id=eos_id,
+            greedy=not temperature > 0.0,
+            t=float(t), k=int(k), p=float(p), keydata=keydata,
+        ))
+        return rid
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(
+            s is not None for s in self._slots
+        )
+
+    def queued_rids(self) -> list[int]:
+        return [q.rid for q in self._queue]
+
+    def active_rids(self) -> list[int]:
+        return [s.rid for s in self._slots if s is not None]
+
+    def step(self, params) -> list[int]:
+        """One scheduler tick: admit queued requests into free slots
+        (prefill), then advance every active row one token (one batched
+        decode dispatch). Returns the rids that finished this tick."""
+        params = self._place_params(params)
+        finished: list[int] = []
+        self._admit(params, finished)
+        if any(s is not None for s in self._slots):
+            self._decode_tick(params, finished)
+        return finished
+
+    def run(self, params, requests=None) -> dict[int, np.ndarray]:
+        """Submit ``requests`` (iterable of ``submit`` kwarg dicts), then
+        drive ``step`` until idle. Returns {rid: tokens} for everything
+        completed during the drive (including previously queued work)."""
+        before = set(self.results)
+        for req in requests or ():
+            self.submit(**req)
+        while self.has_work():
+            self.step(params)
+        return {
+            rid: out for rid, out in self.results.items()
+            if rid not in before
+        }
+
+    def pop_result(self, rid: int) -> np.ndarray | None:
+        """Deliver and RELEASE one request's output: returns the tokens
+        (``None`` if the request was aborted by a failed dispatch) and
+        drops the engine's reference. A long-lived engine retains every
+        retired request's output in ``results`` (and aborted rids in
+        ``aborted``) until delivered — serving loops must pop (or ``del``)
+        what they consume, or host memory grows per request forever."""
+        if rid in self.aborted:
+            self.aborted.discard(rid)
+            return None
+        return self.results.pop(rid)
+
+    def warmup(self, params) -> int:
+        """Compile every (bucket x prefill-group) shape plus the decode
+        program with dummy dispatches (idle engines only — warmup writes
+        garbage rows), so a serving loop's steady state starts
+        compile-free. Returns compile_count()."""
+        if self.has_work():
+            raise RuntimeError("warmup requires an idle engine")
+        if not self.buckets.buckets:
+            raise ValueError(
+                "warmup needs a finite BucketSpec (exact-length mode "
+                "compiles per observed prompt length)"
+            )
+        params = self._place_params(params)
+        for bucket in self.buckets.buckets:
+            for g in self._groups:
+                args = self.example_args(
+                    "prefill", params, bucket=bucket, group=g,
+                    cache=self._take_cache(),
+                )
+                _, cache = self.program("prefill")(*args)
+                self._cache = cache
+        args = self.example_args(
+            "decode_step", params, cache=self._take_cache()
+        )
+        _, cache = self.program("decode_step")(*args)
+        self._cache = cache
+        return self.compile_count()
+
+    # -- scheduler internals -----------------------------------------------
+
+    def _admit(self, params, finished: list[int]) -> None:
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        n = min(len(free), len(self._queue))
+        if not n:
+            return
+        admitted = [self._queue.popleft() for _ in range(n)]
+        # FIFO admission; arrivals sharing a bucket prefill as one
+        # batched dispatch (group padded to the next allowed size).
+        by_bucket: dict[int, list[tuple[_Pending, int]]] = {}
+        for req in admitted:
+            by_bucket.setdefault(req.bucket, []).append(
+                (req, free.pop(0))
+            )
+        for bucket, group in by_bucket.items():
+            self._prefill_group(params, bucket, group, finished)
+
+    def _prefill_group(self, params, bucket, group, finished) -> None:
+        n = len(group)
+        npad = next(g for g in self._groups if g >= n)
+        # Pad the group by DUPLICATING entry 0 (same row index, same
+        # data): the overlapping scatter writes are bit-identical, and
+        # the duplicate's sampled token is discarded.
+        idx = list(range(n)) + [0] * (npad - n)
+        prompts = np.zeros((npad, bucket), np.int32)
+        plens = np.zeros((npad,), np.int32)
+        rows = np.zeros((npad,), np.int32)
+        greedy = np.zeros((npad,), np.bool_)
+        t = np.ones((npad,), np.float32)
+        k = np.full((npad,), self.cfg.vocab_size, np.int32)
+        p = np.full((npad,), 2.0, np.float32)
+        keydata = np.zeros((npad, self._key_words), np.uint32)
+        for j, i in enumerate(idx):
+            req, row = group[i]
+            prompts[j, : req.prompt.shape[0]] = req.prompt
+            plens[j] = req.prompt.shape[0]
+            rows[j] = row
+            greedy[j] = req.greedy
+            t[j], k[j], p[j] = req.t, req.k, req.p
+            keydata[j] = req.keydata
+        toks = self._dispatch(
+            "prefill", params, jnp.asarray(prompts), jnp.asarray(plens),
+            jnp.asarray(rows), None, jnp.asarray(greedy), jnp.asarray(t),
+            jnp.asarray(k), jnp.asarray(p), jnp.asarray(keydata),
+        )
+        toks = np.asarray(toks)
+        for i, (req, row) in enumerate(group):
+            self._slots[row] = _Slot(
+                rid=req.rid, prompt=req.prompt, max_new=req.max_new,
+                eos_id=req.eos_id, pos=int(plens[i]), fold=0,
+                generated=[int(toks[i])], greedy=req.greedy,
+                t=req.t, k=req.k, p=req.p, keydata=req.keydata,
+            )
+            self._maybe_retire(row, finished)
+
+    def _decode_tick(self, params, finished: list[int]) -> None:
+        b = self.slots
+        toks = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        folds = np.zeros((b,), np.int32)
+        greedy = np.ones((b,), np.bool_)
+        t = np.ones((b,), np.float32)
+        k = np.full((b,), self.cfg.vocab_size, np.int32)
+        p = np.full((b,), 2.0, np.float32)
+        keydata = np.zeros((b, self._key_words), np.uint32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue  # free rows decode garbage the host discards
+            toks[i] = s.generated[-1]
+            pos[i] = s.pos
+            folds[i] = s.fold
+            greedy[i] = s.greedy
+            t[i], k[i], p[i] = s.t, s.k, s.p
+            keydata[i] = s.keydata
+        out = self._dispatch(
+            "decode_step", params, jnp.asarray(toks), None,
+            jnp.asarray(pos), jnp.asarray(folds), jnp.asarray(greedy),
+            jnp.asarray(t), jnp.asarray(k), jnp.asarray(p),
+            jnp.asarray(keydata),
+        )
+        out = np.asarray(out)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            s.generated.append(int(out[i]))
+            s.pos += 1
+            s.fold += 1
+            self._maybe_retire(i, finished)
+
+    def _dispatch(self, kind, params, *args):
+        """Run ``kind`` with the engine cache spliced in at its donated
+        argnum. A failed dispatch consumed the donated buffer, so the
+        cache is dropped AND every in-flight row is aborted (its K/V is
+        gone) — queued requests survive and admit into the fresh cache."""
+        cache_at = self.CACHE_ARGNUM[kind] - 1  # args exclude params here
+        args = list(args)
+        args[cache_at] = self._take_cache()
+        try:
+            out, cache = self.program(kind)(params, *args)
+        except BaseException:
+            for i, s in enumerate(self._slots):
+                if s is not None:
+                    self.aborted.add(s.rid)
+                    self._slots[i] = None
+            raise
+        self._cache = cache
+        return out
+
+    def _maybe_retire(self, row: int, finished: list[int]) -> None:
+        s = self._slots[row]
+        hit_eos = s.eos_id is not None and s.generated[-1] == s.eos_id
+        if len(s.generated) < s.max_new and not hit_eos:
+            return
+        # Retirement is pure host bookkeeping: the row's K/V stays in
+        # place (dirty) and the next admission masks it out.
+        self.results[s.rid] = np.concatenate(
+            [s.prompt, np.asarray(s.generated, np.int32)]
+        )
+        self._slots[row] = None
+        finished.append(s.rid)
+
+    # -- introspection -----------------------------------------------------
+
+    def compile_count(self) -> int:
+        """Total compiled executables across both programs: ONE
+        decode_step + one prefill per (bucket, group) shape served. The
+        churn tests assert this stays flat across admissions and
+        retirements at a fixed slot count."""
+        return sum(p._cache_size() for p in self._programs.values())
+
+    def example_args(self, kind: str, params, *, bucket: int | None = None,
+                     group: int = 1, cache: decode.Cache | None = None):
+        """Example argument tuple for lowering/auditing ``kind`` — the
+        shapes ``step`` dispatches with. ``cache=None`` allocates a
+        fresh one (callers doing real dispatches should pass
+        ``self._take_cache()`` and pocket the returned buffer)."""
+        if cache is None:
+            cache = self._new_cache()
+        if kind == "prefill":
+            b = bucket or (
+                self.buckets.buckets[0] if self.buckets.buckets else 4
+            )
+            npad = next(g for g in self._groups if g >= group)
+            return (
+                params,
+                jnp.zeros((npad, b), jnp.int32),
+                jnp.ones((npad,), jnp.int32),
+                jnp.zeros((npad,), jnp.int32),
+                cache,
+                jnp.ones((npad,), jnp.bool_),
+                jnp.ones((npad,), jnp.float32),
+                jnp.full((npad,), self.cfg.vocab_size, jnp.int32),
+                jnp.full((npad,), 2.0, jnp.float32),
+                jnp.zeros((npad, self._key_words), jnp.uint32),
+            )
+        if kind == "decode_step":
+            b = self.slots
+            return (
+                params,
+                jnp.zeros((b,), jnp.int32),
+                cache,
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.ones((b,), jnp.bool_),
+                jnp.ones((b,), jnp.float32),
+                jnp.full((b,), self.cfg.vocab_size, jnp.int32),
+                jnp.full((b,), 2.0, jnp.float32),
+                jnp.zeros((b, self._key_words), jnp.uint32),
+            )
+        raise KeyError(f"unknown batched program kind {kind!r}")
+
+    def verify_donation(self, params) -> dict[str, dict]:
+        """Prove the slot cache actually aliases in/out of both batched
+        programs (strict mode of the donation audit) — the engine-side
+        twin of ``DecodeEngine.verify_donation``. A rejected alias would
+        double-buffer the whole (slots, max_len) cache EVERY TOKEN."""
+        from pytorch_distributed_tpu.analysis.audit import check_donation
+
+        params = self._place_params(params)
+        stats_all: dict[str, dict] = {}
+        for kind in _BATCHED_PROGRAM_KINDS:
+            args = self.example_args(kind, params)
+            compiled = self.program(kind).lower(*args).compile()
+            findings, stats = check_donation(
+                compiled.as_text(), args, (self.CACHE_ARGNUM[kind],),
+                strict=True,
+            )
+            stats_all[kind] = stats
+            if findings:
+                raise RuntimeError(
+                    f"batched engine program {kind!r} ({self.mode}): "
+                    "donated slot KV cache does not fully alias in the "
+                    f"compiled executable — {findings[0].message}"
                 )
         return stats_all
 

@@ -19,11 +19,20 @@ prefetch gather schedule against just-in-time gathers, with the
 trace-derived hidden-comm fraction (profiling/trace_analysis.py).
 Artifact: benchmarks/serving_bench.json (``--json``).
 
+``--serving-batched`` benchmarks CONTINUOUS BATCHING: the slot-scheduled
+``BatchedDecodeEngine`` vs the serial engine on one seeded Poisson-ish
+mixed-length arrival stream — aggregate steady-state tok/s plus
+per-request p50/p99 latency derived from the SAME per-request completion
+timestamps, and the steady-state compile count of each leg (expected 0).
+Artifact: benchmarks/serving_batched_bench.json.
+
 Usage:
   python scripts/decode_bench.py                    # gpt2 + llama3-1b
   python scripts/decode_bench.py --preset gpt2 --batch 8
   python scripts/decode_bench.py --serving --cpu-devices 8 \\
       --json benchmarks/serving_bench.json
+  python scripts/decode_bench.py --serving-batched \\
+      --json benchmarks/serving_batched_bench.json
   python scripts/decode_bench.py --serving --dryrun --cpu-devices 8  # CI
 """
 
@@ -513,6 +522,182 @@ def bench_serving(args) -> list[dict]:
     return rows
 
 
+def bench_serving_batched(args) -> list[dict]:
+    """Continuous batching (serving/engine.BatchedDecodeEngine) vs the
+    PR-4 serial engine on the SAME Poisson-ish mixed-length arrival
+    stream, at equal per-row cache capacity (same max_len; the batched
+    engine additionally holds `slots` rows — that concurrency is the
+    feature under test, not a handicap to equalise away).
+
+    Methodology: one seeded arrival schedule (exponential inter-arrival
+    times calibrated to ~2x the serial engine's measured warm service
+    rate, so the serial leg saturates the way real traffic would) is
+    replayed through both legs in VIRTUAL time driven by measured wall
+    service times: the serial leg serves requests FIFO one at a time
+    (completion = max(prev completion, arrival) + measured service); the
+    batched leg advances its scheduler clock by each measured step()
+    dispatch and admits arrivals as the clock passes them. Aggregate
+    tok/s AND the p50/p99 request latencies are derived from the SAME
+    per-request completion timestamps (the ADVICE r5 discipline: one set
+    of measurements feeds every derived field, so the row cannot
+    disagree with itself). Warmup (every bucket x group shape, both
+    greedy/sampled serial variants) runs before the clock starts;
+    steady-state compile counts are reported and expected to be ZERO for
+    both legs — the batched engine's by construction (fixed shapes),
+    the serial engine's because buckets are finite.
+    """
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.serving.engine import (
+        BatchedDecodeEngine,
+        BucketSpec,
+        DecodeEngine,
+    )
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _serving_cfg(args.dryrun)
+    slots = 4 if args.dryrun else 8
+    max_new = 12 if args.dryrun else 32
+    max_len = 160 if args.dryrun else 384
+    n_req = 16 if args.dryrun else 48
+    buckets = BucketSpec.powers_of_two(
+        max_len - max_new, min_bucket=16 if args.dryrun else 32
+    )
+    seed = int.from_bytes(os.urandom(4), "little")
+    params = get_model(cfg).init(domain_key(seed, "init"), cfg)
+    rng = np.random.default_rng(seed)
+    key = jax.random.key(seed)
+
+    configs = [
+        dict(temperature=0.8, top_k=20),
+        dict(temperature=1.0, top_p=0.9),
+        dict(),  # greedy rows share the batch with sampled ones
+    ]
+    lengths = [
+        int(x) for x in rng.integers(4, buckets.buckets[-1] + 1, n_req)
+    ]
+    requests = [
+        (
+            np.asarray(
+                rng.integers(0, cfg.vocab_size, (tp,)), np.int32
+            ),
+            configs[i % len(configs)],
+        )
+        for i, tp in enumerate(lengths)
+    ]
+
+    serial = DecodeEngine(cfg, max_len=max_len, buckets=buckets)
+    batched = BatchedDecodeEngine(
+        cfg, slots=slots, max_len=max_len, buckets=buckets
+    )
+
+    def serial_call(prompt, ckw):
+        kw = dict(ckw)
+        if kw.get("temperature"):
+            kw["key"] = key
+        out = serial.generate(params, prompt[None], max_new, **kw)
+        np.asarray(out)  # fence
+
+    # Warm both legs (charged to warmup, outside the measured stream).
+    for tp in buckets.buckets:
+        p_warm = np.zeros((min(tp, max_len - max_new),), np.int32)
+        serial_call(p_warm, configs[0])
+        serial_call(p_warm, configs[2])
+    batched.warmup(params)
+    serial_warm_compiles = serial.compile_count()
+    batched_warm_compiles = batched.compile_count()
+
+    # Calibrate the arrival process to the serial engine's service rate.
+    t0 = time.perf_counter()
+    serial_call(requests[0][0], requests[0][1])
+    service_est = time.perf_counter() - t0
+    mean_interarrival = service_est / 2.0  # ~2x serial capacity
+    arrivals = np.concatenate(
+        [[0.0], np.cumsum(rng.exponential(mean_interarrival, n_req - 1))]
+    )
+
+    # Serial leg: FIFO, one request at a time, virtual clock over
+    # measured service times.
+    clock = 0.0
+    serial_lat = []
+    for arr, (prompt, ckw) in zip(arrivals, requests):
+        t0 = time.perf_counter()
+        serial_call(prompt, ckw)
+        dt = time.perf_counter() - t0
+        clock = max(clock, arr) + dt
+        serial_lat.append(clock - arr)
+    serial_span = clock - arrivals[0]
+    serial_steady_compiles = serial.compile_count() - serial_warm_compiles
+
+    # Batched leg: same schedule; admit as the scheduler clock passes
+    # each arrival, advance by measured step() time.
+    clock = 0.0
+    pending = list(zip(arrivals, range(n_req)))
+    submitted: dict[int, float] = {}
+    batched_lat: dict[int, float] = {}
+    while pending or batched.has_work():
+        while pending and pending[0][0] <= clock:
+            arr, i = pending.pop(0)
+            prompt, ckw = requests[i]
+            kw = dict(ckw)
+            if kw.get("temperature"):
+                kw["key"] = key
+            rid = batched.submit(prompt, max_new, **kw)
+            submitted[rid] = arr
+        if not batched.has_work():
+            clock = pending[0][0]  # idle until the next arrival
+            continue
+        t0 = time.perf_counter()
+        done = batched.step(params)
+        clock += time.perf_counter() - t0
+        for rid in done:
+            batched_lat[rid] = clock - submitted[rid]
+    batched_span = clock - arrivals[0]
+    batched_steady_compiles = (
+        batched.compile_count() - batched_warm_compiles
+    )
+
+    def _pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
+
+    total_tokens = n_req * max_new
+
+    def _leg(span, lat, steady_compiles):
+        lat = list(lat)
+        return {
+            "steady_tokens_per_sec": round(total_tokens / span, 1),
+            "p50_request_ms": round(_pct(lat, 0.50) * 1e3, 2),
+            "p99_request_ms": round(_pct(lat, 0.99) * 1e3, 2),
+            "observed_compile_count_steady": steady_compiles,
+        }
+
+    row = {
+        "leg": "serving_batched_stream",
+        "model": dict(
+            n_embd=cfg.n_embd, n_layer=cfg.n_layer,
+            vocab_size=cfg.vocab_size,
+        ),
+        "slots": slots,
+        "max_new": max_new,
+        "max_len": max_len,
+        "requests": n_req,
+        "buckets": list(buckets.buckets),
+        "sampling_configs": len(configs),
+        "mean_interarrival_ms": round(mean_interarrival * 1e3, 2),
+        "arrival_process": "seeded exponential (~2x serial capacity)",
+        "serial": _leg(serial_span, serial_lat, serial_steady_compiles),
+        "batched": _leg(
+            batched_span, batched_lat.values(), batched_steady_compiles
+        ),
+        "aggregate_speedup": round(serial_span / batched_span, 3),
+        "platform": jax.devices()[0].platform,
+    }
+    return [row]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default=None,
@@ -540,16 +725,26 @@ def main() -> int:
                     help="benchmark the serving engine vs the legacy "
                          "per-call path on a mixed-length request stream "
                          "(+ ZeRO-3 prefetch decode when >= 2 devices)")
+    ap.add_argument("--serving-batched", action="store_true",
+                    help="benchmark continuous batching "
+                         "(BatchedDecodeEngine) vs the serial engine on "
+                         "a Poisson-ish mixed-length arrival stream "
+                         "(benchmarks/serving_batched_bench.json)")
     ap.add_argument("--dryrun", action="store_true",
-                    help="with --serving: tiny shapes for the CI smoke")
+                    help="with --serving/--serving-batched: tiny shapes "
+                         "for the CI smoke")
     ap.add_argument("--json", default=None,
-                    help="with --serving: write the rows here "
-                         "(benchmarks/serving_bench.json)")
+                    help="with --serving/--serving-batched: write the "
+                         "rows here")
     args = ap.parse_args()
     setup_platform(args)
 
-    if args.serving:
-        rows = bench_serving(args)
+    if args.serving or args.serving_batched:
+        rows = []
+        if args.serving:
+            rows += bench_serving(args)
+        if args.serving_batched:
+            rows += bench_serving_batched(args)
         for row in rows:
             print(json.dumps(row))
         if args.json:

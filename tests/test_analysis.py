@@ -933,3 +933,30 @@ def test_decode_engine_cases_pinned(eight_devices):
     assert "all-gather" in zbudget.required
     assert zkwargs["donation_strict"]
     assert zkwargs["donate_argnums"] == (2,)
+
+
+def test_batched_decode_cases_pinned(eight_devices):
+    """The slot-batched serving registry cases (PR 5): strict
+    donated-slot-cache aliasing at the cache's real argnum, NO_COLLECTIVES
+    on the single-device programs, and the pinned all-reduce ceiling on
+    the TP decode step — a count that is invariant to the active-row
+    pattern because activity never reaches the program (per-row state is
+    traced operands)."""
+    from pytorch_distributed_tpu.analysis.budget import STABLE_MAX_COUNTS
+    from pytorch_distributed_tpu.analysis.registry import registered_cases
+
+    cases = registered_cases()
+    for name, cache_argnum in (
+        ("decode_batched_prefill", 4), ("decode_batched_step", 2),
+    ):
+        _, _, budget, kwargs = cases[name].build()
+        assert budget.forbidden, name  # NO_COLLECTIVES
+        assert kwargs["donation_strict"], name
+        assert kwargs["donate_argnums"] == (cache_argnum,), name
+    _, _, tbudget, tkwargs = cases["decode_batched_step_tp"].build()
+    assert tbudget.max_counts == STABLE_MAX_COUNTS["decode_batched_step_tp"]
+    assert STABLE_MAX_COUNTS["decode_batched_step_tp"] == {"all-reduce": 2}
+    assert "all-reduce" in tbudget.required
+    assert "all-gather" in tbudget.forbidden
+    assert tkwargs["donation_strict"]
+    assert tkwargs["donate_argnums"] == (2,)

@@ -306,6 +306,8 @@ def test_engine_request_validation():
     eng = DecodeEngine(cfg, max_len=16, buckets=BucketSpec((8, 16)))
     with pytest.raises(ValueError, match="exceeds the engine max_len"):
         eng.generate(params, prompt, 12)
+    with pytest.raises(ValueError, match="empty prompt"):
+        eng.generate(params, np.zeros((1, 0), np.int32), 4)
     with pytest.raises(ValueError, match="PRNG key"):
         eng.generate(params, prompt, 4, temperature=0.5)
     # max_new_tokens=0 returns the prompt unchanged, touching no program.
