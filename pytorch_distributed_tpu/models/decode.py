@@ -459,9 +459,9 @@ def generate_monolithic(
     cache is jit-internal, re-allocated and re-zeroed every call — the
     cost ``serving.engine.DecodeEngine``'s donated cache pool removes.
     """
-    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
-    if early is not None:
-        return early
+    key = _check_sample_args(
+        prompt, max_new_tokens, temperature, key, max_len=max_len
+    )
     t, k, p = sampling_scalars(temperature, top_k, top_p, cfg.vocab_size)
     return _monolithic_jit(
         params, prompt, key, t, k, p,
@@ -490,9 +490,9 @@ def generate(
     cache donated between them and pooled across calls. Bit-equal to
     ``generate_monolithic`` (pinned in tests/test_serving.py).
     """
-    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
-    if early is not None:
-        return early
+    key = _check_sample_args(
+        prompt, max_new_tokens, temperature, key, max_len=max_len
+    )
     from pytorch_distributed_tpu.serving.engine import shim_engine
 
     engine = shim_engine(
@@ -571,9 +571,9 @@ def generate_tp(
     ``DecodeEngine``; ``generate_tp_monolithic`` is the one-jit reference.
     """
     _validate_tp_mesh(cfg, mesh_cfg)
-    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
-    if early is not None:
-        return early
+    key = _check_sample_args(
+        prompt, max_new_tokens, temperature, key, max_len=max_len
+    )
     from pytorch_distributed_tpu.serving.engine import shim_engine
 
     engine = shim_engine(
@@ -601,9 +601,9 @@ def generate_tp_monolithic(
 ) -> jax.Array:
     """One-jit TP generation (the pre-engine reference path)."""
     _validate_tp_mesh(cfg, mesh_cfg)
-    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
-    if early is not None:
-        return early
+    key = _check_sample_args(
+        prompt, max_new_tokens, temperature, key, max_len=max_len
+    )
 
     fn, shardings = _tp_generate_compiled(
         cfg, mesh_cfg, max_new_tokens, max_len, temperature > 0
@@ -614,26 +614,54 @@ def generate_tp_monolithic(
     return fn(jax.device_put(params, shardings), prompt, key, t, k, p)
 
 
-def _check_sample_args(prompt, max_new_tokens, temperature, key):
-    """Shared generate-entry validation. Returns (early_out, key): when
-    ``early_out`` is not None the caller returns it unchanged (nothing to
-    generate — the write of the first sampled token would statically index
-    out of bounds); otherwise ``key`` is non-None (greedy paths get a
-    dummy, unused by sampling)."""
-    if prompt.shape[-1] == 0:
+def nonfinite_rows(logits: jax.Array) -> jax.Array:
+    """[B, V] (or [B, T, V]) -> [B] bool: True where ANY logit in the row
+    is NaN/Inf — the cheap traced fault sentinel every serving program
+    returns next to its sampled token (serving/engine.py). Reduces over
+    every axis but the batch axis; elementwise + one reduction, so it adds
+    no collectives to any program (the audit registry pins the budgets)
+    and costs nothing against the decode step's matmuls."""
+    axes = tuple(range(1, logits.ndim))
+    return jnp.any(~jnp.isfinite(logits), axis=axes)
+
+
+def _check_sample_args(prompt, max_new_tokens, temperature, key,
+                       max_len=None):
+    """Shared generate-entry validation; returns the PRNG key (greedy
+    paths get a dummy, unused by sampling). Rejects loudly, naming the
+    limit, instead of failing late in a compiled program:
+
+    - empty prompts (the first token would sample from a pad position);
+    - ``max_new_tokens <= 0`` (a generate that generates nothing is a
+      caller bug — the old 0-token early-return silently returned the
+      prompt, which hid budget-accounting mistakes in serving loops);
+    - ``prompt + max_new_tokens > max_len`` when the cache capacity is
+      known (the KV write past ``max_len`` would otherwise fail deep in
+      dispatch or silently clamp);
+    - temperature sampling without a key.
+    """
+    tp = prompt.shape[-1]
+    if tp == 0:
         raise ValueError(
             "empty prompt: need at least one token to prefill (an empty "
             "prompt would sample the first token from a pad position)"
         )
-    if max_new_tokens < 0:
-        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
-    if max_new_tokens == 0:
-        return prompt.astype(jnp.int32), key
+    if max_new_tokens <= 0:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens} — a "
+            "request that generates nothing is a no-op; don't dispatch it"
+        )
+    if max_len is not None and tp + max_new_tokens > max_len:
+        raise ValueError(
+            f"prompt ({tp}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"max_len {max_len}: the KV cache holds max_len positions, so "
+            "the request cannot fit — shorten it or raise max_len"
+        )
     if temperature > 0.0 and key is None:
         raise ValueError("temperature sampling requires a PRNG key")
     if key is None:
         key = jax.random.key(0)
-    return None, key
+    return key
 
 
 def _mesh_param_shardings(cfg, mesh_cfg):
@@ -689,9 +717,9 @@ def generate_fsdp(
     are ordinary auto-sharded ops here).
     """
     _validate_fsdp_mesh(mesh_cfg)
-    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
-    if early is not None:
-        return early
+    key = _check_sample_args(
+        prompt, max_new_tokens, temperature, key, max_len=max_len
+    )
     from pytorch_distributed_tpu.serving.engine import shim_engine
 
     engine = shim_engine(
@@ -723,9 +751,9 @@ def generate_fsdp_monolithic(
     per-layer gathers (the stacked [L, ...] block leaves shard a WEIGHT
     dim, never L — parallel/sharding.py)."""
     _validate_fsdp_mesh(mesh_cfg)
-    early, key = _check_sample_args(prompt, max_new_tokens, temperature, key)
-    if early is not None:
-        return early
+    key = _check_sample_args(
+        prompt, max_new_tokens, temperature, key, max_len=max_len
+    )
 
     fn, shardings = _fsdp_generate_compiled(
         cfg, mesh_cfg, max_new_tokens, max_len, temperature > 0

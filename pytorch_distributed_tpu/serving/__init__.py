@@ -1,10 +1,29 @@
 """Serving fast path: the persistent donated-KV decode engines (serial
 per-request DecodeEngine + slot-scheduled continuous-batching
-BatchedDecodeEngine)."""
+BatchedDecodeEngine), the request-lifecycle vocabulary (terminal states,
+results, snapshots — serving/lifecycle.py) and the deterministic
+fault-injection harness (serving/chaos.py)."""
 
+from pytorch_distributed_tpu.serving.chaos import (  # noqa: F401
+    Fault,
+    FaultInjector,
+    VirtualClock,
+)
 from pytorch_distributed_tpu.serving.engine import (  # noqa: F401
     BatchedDecodeEngine,
     BucketSpec,
     DecodeEngine,
     shim_engine,
+)
+from pytorch_distributed_tpu.serving.lifecycle import (  # noqa: F401
+    ABORTED,
+    DONE,
+    EXPIRED,
+    FAILED,
+    TERMINAL_STATES,
+    AdmissionQueueFull,
+    DispatchFailure,
+    EngineSnapshot,
+    RequestFailed,
+    RequestResult,
 )

@@ -1,0 +1,202 @@
+"""Deterministic fault injection for the serving engines.
+
+Robustness claims are only as good as the faults they were tested
+against, and real faults (device resets, NaN-producing kernels, lost
+RPCs, scheduler stalls) are neither reproducible nor cheap to provoke.
+This module makes them both: a ``FaultInjector`` installed on a
+``BatchedDecodeEngine`` (``engine.set_fault_injector``) drives seeded,
+composable injections through HOST-SIDE hooks only — nothing traced ever
+sees it, so injection cannot change a compiled program, its shapes, or
+its pinned collective budgets (the whole point: the fault paths must
+exercise the SAME executables production runs).
+
+Injection points (the full catalog — docs/ROBUSTNESS.md):
+
+- ``dispatch_error`` — raise before the program runs. The donated cache
+  was already taken, so the engine sees exactly what a device-side
+  dispatch failure looks like: buffer consumed, in-flight K/V gone.
+- ``drop_result``   — raise AFTER the program ran: the compute happened
+  and the cache was consumed, but the result never reached the
+  scheduler (a lost RPC/transfer). Same recovery path, cost paid.
+- ``nan_row``       — flip one active row's non-finite sentinel flag,
+  simulating a poisoned logits row at the scheduler boundary (the
+  traced sentinel itself is tested separately with genuinely-NaN
+  params). Targets decode ticks; transient by default, so the
+  quarantine retry succeeds.
+- ``slow_tick``     — advance the engine's ``VirtualClock``, modelling a
+  stall; this is how deadline expiries are driven deterministically.
+
+Faults come scripted (``Fault(tick=...)`` — exact, for tests) and/or
+seeded (per-tick Bernoulli draws from one ``numpy`` generator — for the
+soak and the chaos bench leg); both compose. Every firing is counted in
+``injector.counts`` so a run can assert its fault schedule actually
+fired (a chaos test that injected nothing is coverage theater).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+FAULT_KINDS = ("dispatch_error", "drop_result", "nan_row", "slow_tick")
+
+
+class ChaosDispatchError(RuntimeError):
+    """Injected device-side dispatch failure (program never ran; the
+    donated cache is consumed regardless)."""
+
+
+class ChaosDroppedResult(RuntimeError):
+    """Injected result loss: the program ran (cache consumed, compute
+    paid) but the output never reached the scheduler."""
+
+
+class VirtualClock:
+    """A deterministic engine clock: advances ONLY via ``sleep``/
+    ``advance`` (backoff sleeps and slow-tick faults). Pass as both
+    ``clock=`` and ``sleep=`` to the engine so deadlines, backoff, and
+    stalls replay identically run after run."""
+
+    def __init__(self, start: float = 0.0) -> None:
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.now += max(0.0, float(seconds))
+
+    advance = sleep
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One scripted injection. ``tick`` is the engine's step counter
+    (first step = tick 1). ``program`` restricts dispatch faults to
+    'prefill' / 'decode_step' (None = first dispatch of the tick);
+    ``row`` picks the nan_row target slot (None = seeded choice among
+    active rows); ``seconds`` is the slow_tick stall."""
+
+    tick: int
+    kind: str
+    program: str | None = None
+    row: int | None = None
+    seconds: float | None = None  # None = injector's slow_tick_s
+
+    def __post_init__(self) -> None:
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; known: {FAULT_KINDS}"
+            )
+
+
+class FaultInjector:
+    """Seeded + scripted fault schedule over an engine's dispatch hooks.
+
+    ``faults``: scripted ``Fault`` list (fires exactly once each).
+    ``seed``: enables the random schedule — each tick draws one
+    Bernoulli per probability from a private generator, so the schedule
+    is a pure function of (seed, tick sequence). ``clock``: the engine's
+    ``VirtualClock``, required for slow_tick faults.
+    """
+
+    def __init__(
+        self,
+        faults: tuple[Fault, ...] | list[Fault] = (),
+        *,
+        seed: int | None = None,
+        p_dispatch_error: float = 0.0,
+        p_drop_result: float = 0.0,
+        p_nan_row: float = 0.0,
+        p_slow_tick: float = 0.0,
+        slow_tick_s: float = 0.25,
+        clock: VirtualClock | None = None,
+    ) -> None:
+        self._scripted: dict[int, list[Fault]] = {}
+        for f in faults:
+            self._scripted.setdefault(f.tick, []).append(f)
+        self._rng = (
+            np.random.default_rng(seed) if seed is not None else None
+        )
+        self._p = {
+            "dispatch_error": p_dispatch_error,
+            "drop_result": p_drop_result,
+            "nan_row": p_nan_row,
+            "slow_tick": p_slow_tick,
+        }
+        self._slow_tick_s = float(slow_tick_s)
+        self._clock = clock
+        self._engine = None
+        self._armed: list[Fault] = []  # this tick's not-yet-fired faults
+        self.counts = {k: 0 for k in FAULT_KINDS}
+
+    def install(self, engine) -> "FaultInjector":
+        engine.set_fault_injector(self)  # sets our _engine back-reference
+        return self
+
+    # -- engine hooks (host-side only) --------------------------------------
+
+    def on_tick(self, tick: int) -> None:
+        """Arm this tick's faults (scripted + seeded draws) and apply
+        slow_tick stalls immediately."""
+        self._armed = list(self._scripted.pop(tick, ()))
+        if self._rng is not None:
+            for kind, p in self._p.items():
+                if p > 0.0 and self._rng.random() < p:
+                    self._armed.append(
+                        Fault(tick, kind, seconds=self._slow_tick_s)
+                    )
+        for f in [f for f in self._armed if f.kind == "slow_tick"]:
+            self._armed.remove(f)
+            if self._clock is None:
+                raise ValueError(
+                    "slow_tick faults need the engine's VirtualClock "
+                    "passed as FaultInjector(clock=...)"
+                )
+            self._clock.advance(
+                self._slow_tick_s if f.seconds is None else f.seconds
+            )
+            self.counts["slow_tick"] += 1
+
+    def before_dispatch(self, kind: str, tick: int) -> None:
+        f = self._pop("dispatch_error", kind)
+        if f is not None:
+            self.counts["dispatch_error"] += 1
+            raise ChaosDispatchError(
+                f"injected dispatch failure (tick {tick}, {kind})"
+            )
+
+    def after_dispatch(self, kind: str, tick: int, tok, bad):
+        f = self._pop("drop_result", kind)
+        if f is not None:
+            self.counts["drop_result"] += 1
+            raise ChaosDroppedResult(
+                f"injected result loss (tick {tick}, {kind})"
+            )
+        if kind == "decode_step":
+            f = self._pop("nan_row", kind)
+            if f is not None:
+                row = f.row
+                if row is None:
+                    active = [
+                        i for i, s in enumerate(self._engine._slots)
+                        if s is not None
+                    ]
+                    if not active:
+                        return tok, bad
+                    picker = self._rng or np.random.default_rng(tick)
+                    row = int(active[picker.integers(len(active))])
+                bad = np.asarray(bad).copy()
+                bad[row] = True
+                self.counts["nan_row"] += 1
+        return tok, bad
+
+    # -- internals -----------------------------------------------------------
+
+    def _pop(self, kind: str, program: str) -> Fault | None:
+        for f in self._armed:
+            if f.kind == kind and f.program in (None, program):
+                self._armed.remove(f)
+                return f
+        return None

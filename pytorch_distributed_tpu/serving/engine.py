@@ -76,6 +76,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import time
 from typing import Any
 
 import jax
@@ -84,6 +85,18 @@ import numpy as np
 
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 from pytorch_distributed_tpu.models import decode
+from pytorch_distributed_tpu.serving.lifecycle import (
+    ABORTED,
+    DONE,
+    EXPIRED,
+    FAILED,
+    AdmissionQueueFull,
+    DispatchFailure,
+    EngineSnapshot,
+    RequestFailed,
+    RequestResult,
+)
+from pytorch_distributed_tpu.utils.logging import log_event
 
 _PROGRAM_KINDS = ("prefill", "decode_run", "decode_step")
 _BATCHED_PROGRAM_KINDS = ("prefill", "decode_step")
@@ -192,6 +205,7 @@ class DecodeEngine:
         mesh_cfg: MeshConfig | None = None,
         pool_caches: bool = True,
         pool_max_entries: int = 8,
+        nan_guard: bool = True,
     ) -> None:
         if max_len > cfg.n_ctx:
             raise ValueError(
@@ -235,6 +249,12 @@ class DecodeEngine:
             )
         self._pool_max = int(pool_max_entries)
         self._cache_pool: dict[int, decode.Cache] = {}
+        # Fault sentinel: every program returns a per-row non-finite-logits
+        # flag; with the guard on, ``generate`` fetches it (one tiny
+        # host read per REQUEST, not per token), retries a poisoned
+        # request ONCE on a fresh zeroed cache, then fails loudly
+        # (lifecycle.RequestFailed) instead of returning garbage tokens.
+        self._nan_guard = bool(nan_guard)
 
     # -- cache pool --------------------------------------------------------
 
@@ -303,7 +323,11 @@ class DecodeEngine:
     def _bodies(self, sampled: bool):
         """The three raw program bodies for one greedy/sampled variant.
         Sampling scalars are always in the signature (greedy programs
-        trace-and-drop them) so every program keys the same way."""
+        trace-and-drop them) so every program keys the same way. Every
+        body returns a traced NaN/Inf sentinel next to its tokens
+        (``decode.nonfinite_rows`` over the sampled-position logits):
+        elementwise + one reduction, no collectives — the registry
+        budgets for these programs are unchanged by it."""
 
         def prefill(params, prompt, prompt_len, cache,
                     temperature, top_k, top_p, key):
@@ -314,35 +338,39 @@ class DecodeEngine:
             tok = decode.sample_token(
                 last, sampled, temperature, key, top_k, top_p
             )
-            return tok, cache
+            return tok, decode.nonfinite_rows(last), cache
 
         def decode_run(params, tok, cache, pos, n_steps,
                        temperature, top_k, top_p, key):
             out = jnp.zeros((tok.shape[0], self.max_len), jnp.int32)
+            bad = jnp.zeros((tok.shape[0],), jnp.bool_)
 
             def step(i, carry):
-                out, cache, tok = carry
+                out, bad, cache, tok = carry
                 logits, cache = self._forward(
                     params, tok[:, None], cache, pos + i
                 )
+                last = logits[:, -1]
                 nxt = decode.sample_token(
-                    logits[:, -1], sampled, temperature,
+                    last, sampled, temperature,
                     jax.random.fold_in(key, i), top_k, top_p,
                 )
-                return out.at[:, i].set(nxt), cache, nxt
+                bad = bad | decode.nonfinite_rows(last)
+                return out.at[:, i].set(nxt), bad, cache, nxt
 
-            out, cache, _ = jax.lax.fori_loop(
-                0, n_steps, step, (out, cache, tok)
+            out, bad, cache, _ = jax.lax.fori_loop(
+                0, n_steps, step, (out, bad, cache, tok)
             )
-            return out, cache
+            return out, bad, cache
 
         def decode_step(params, tok, cache, pos,
                         temperature, top_k, top_p, key):
             logits, cache = self._forward(params, tok[:, None], cache, pos)
+            last = logits[:, -1]
             tok = decode.sample_token(
-                logits[:, -1], sampled, temperature, key, top_k, top_p
+                last, sampled, temperature, key, top_k, top_p
             )
-            return tok, cache
+            return tok, decode.nonfinite_rows(last), cache
 
         return {
             "prefill": prefill,
@@ -391,7 +419,7 @@ class DecodeEngine:
                 body,
                 mesh=self._mesh,
                 in_specs=specs,
-                out_specs=(P(), cache_spec),
+                out_specs=(P(), P(), cache_spec),
                 check_vma=True,
             )
             prog = jax.jit(smapped, donate_argnums=donate)
@@ -405,7 +433,7 @@ class DecodeEngine:
             prog = jax.jit(
                 body,
                 in_shardings=tuple(in_sh),
-                out_shardings=(replicated, replicated),
+                out_shardings=(replicated, replicated, replicated),
                 donate_argnums=donate,
             )
         self._programs[(kind, sampled)] = prog
@@ -421,13 +449,10 @@ class DecodeEngine:
 
     def _request_setup(self, prompt, max_new_tokens, temperature,
                        top_k, top_p):
+        # Budget overflow (prompt + max_new > max_len) is rejected by
+        # decode._check_sample_args at every entry before this runs.
         prompt = jnp.asarray(prompt)
         b, tp = prompt.shape
-        if tp + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt ({tp}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds the engine max_len {self.max_len}"
-            )
         bucket = self.buckets.bucket_for(tp)
         padded = (
             prompt
@@ -451,18 +476,47 @@ class DecodeEngine:
         top_p: float | None = None,
     ) -> jax.Array:
         """Serve one request: returns [B, Tp + max_new_tokens] — the same
-        tokens the monolithic reference produces for this request."""
-        early, key = decode._check_sample_args(
-            prompt, max_new_tokens, temperature, key
+        tokens the monolithic reference produces for this request. With
+        ``nan_guard`` (default), non-finite logits anywhere in the
+        request retry it ONCE on a fresh zeroed cache, then raise
+        ``lifecycle.RequestFailed`` — garbage tokens never escape."""
+        key = decode._check_sample_args(
+            prompt, max_new_tokens, temperature, key, max_len=self.max_len
         )
-        if early is not None:
-            return early
         prompt, padded, b, tp, t, k, p = self._request_setup(
             prompt, max_new_tokens, temperature, top_k, top_p
         )
         sampled = temperature > 0
         params = self._place_params(params)
-        cache = self._take_cache(b)
+        for attempt in range(2 if self._nan_guard else 1):
+            out, bad = self._generate_once(
+                params, prompt, padded, b, tp, max_new_tokens, sampled,
+                t, k, p, key, fresh_cache=attempt > 0,
+            )
+            if not self._nan_guard or not bool(np.asarray(bad).any()):
+                return out
+            # Poisoned: drop the (pooled) buffer this request ran on and
+            # retry once from a fresh zeroed allocation — the one failure
+            # mode the masking discipline cannot absolve is a transient
+            # corruption inside the request's own live rows.
+            self._cache_pool.pop(b, None)
+            log_event(
+                "nan_detected", engine="serial", batch=b,
+                attempt=attempt, prompt_len=tp,
+            )
+        raise RequestFailed(
+            "non-finite logits persisted after one fresh-cache retry "
+            f"(batch={b}, prompt_len={tp}): the model/params produce "
+            "NaN/Inf for this input — refusing to return garbage tokens"
+        )
+
+    def _generate_once(self, params, prompt, padded, b, tp,
+                       max_new_tokens, sampled, t, k, p, key, *,
+                       fresh_cache: bool):
+        """One full prefill + decode_run attempt. Returns (tokens, bad)
+        where ``bad`` is the device-side [B] non-finite sentinel OR-ed
+        over every step of the request."""
+        cache = self.new_cache(b) if fresh_cache else self._take_cache(b)
         plen = jnp.asarray(tp, jnp.int32)
 
         # A failed dispatch DROPS the buffer instead of pooling it: once
@@ -471,24 +525,25 @@ class DecodeEngine:
         # pool with a deleted array; the next request simply re-allocates
         # (the cost a healthy pool avoids, paid only after a failure).
         try:
-            tok, cache = self.program("prefill", sampled)(
+            tok, bad, cache = self.program("prefill", sampled)(
                 params, padded, plen, cache, t, k, p, key
             )
             pieces = [prompt.astype(jnp.int32), tok[:, None]]
             n = max_new_tokens - 1
             if n > 0:
-                out, cache = self.program("decode_run", sampled)(
+                out, bad_run, cache = self.program("decode_run", sampled)(
                     params, tok, cache, plen, jnp.asarray(n, jnp.int32),
                     t, k, p, key,
                 )
                 pieces.append(out[:, :n])
+                bad = jnp.logical_or(bad, bad_run)
         except BaseException:
             cache = None
             raise
         finally:
             if cache is not None:
                 self._return_cache(b, cache)
-        return jnp.concatenate(pieces, axis=1)
+        return jnp.concatenate(pieces, axis=1), bad
 
     def stream(
         self,
@@ -505,12 +560,14 @@ class DecodeEngine:
         dispatch — the streaming form of ``generate`` (identical tokens:
         same programs modulo the fused loop, same key folding). The cache
         buffer returns to the pool when the generator finishes or is
-        closed."""
-        early, key = decode._check_sample_args(
-            prompt, max_new_tokens, temperature, key
+        closed. With ``nan_guard``, a poisoned step raises
+        ``lifecycle.RequestFailed`` immediately — a stream cannot retry
+        transparently (tokens already escaped to the client), so the
+        client resubmits; the per-step sentinel fetch costs nothing extra
+        (streaming clients fetch every token anyway)."""
+        key = decode._check_sample_args(
+            prompt, max_new_tokens, temperature, key, max_len=self.max_len
         )
-        if early is not None:
-            return
         prompt, padded, b, tp, t, k, p = self._request_setup(
             prompt, max_new_tokens, temperature, top_k, top_p
         )
@@ -518,21 +575,33 @@ class DecodeEngine:
         params = self._place_params(params)
         cache = self._take_cache(b)
         plen = jnp.asarray(tp, jnp.int32)
+
+        def _guard(bad):
+            if self._nan_guard and bool(np.asarray(bad).any()):
+                # Poisoned buffers never rejoin the pool.
+                raise RequestFailed(
+                    "non-finite logits mid-stream (batch="
+                    f"{b}, prompt_len={tp}): aborting the stream — "
+                    "resubmit via generate() for the fresh-cache retry"
+                )
+
         # Same drop-on-dispatch-failure rule as generate(); an early
         # generator close (GeneratorExit at a yield) is NOT a failed
         # dispatch — `cache` is the last returned buffer and goes back
         # to the pool.
         try:
-            tok, cache = self.program("prefill", sampled)(
+            tok, bad, cache = self.program("prefill", sampled)(
                 params, padded, plen, cache, t, k, p, key
             )
+            _guard(bad)
             yield tok
             step = self.program("decode_step", sampled)
             for i in range(max_new_tokens - 1):
-                tok, cache = step(
+                tok, bad, cache = step(
                     params, tok, cache, jnp.asarray(tp + i, jnp.int32),
                     t, k, p, jax.random.fold_in(key, i),
                 )
+                _guard(bad)
                 yield tok
         except GeneratorExit:
             raise
@@ -612,18 +681,29 @@ class DecodeEngine:
 @dataclasses.dataclass
 class _Pending:
     """A queued request (host-side): everything the prefill dispatch
-    needs, encoded once at submit time."""
+    needs, encoded once at submit time. The same record doubles as a
+    RESUME entry after a fault (NaN quarantine, dispatch failure, engine
+    replay): ``gen`` then holds the clean tokens generated before the
+    fault, and admission prefills the whole prompt+gen prefix — with
+    ``prefill_keydata`` pre-folded on the host to the prefix's position
+    in the per-request fold schedule, so the continuation's draws are
+    bit-identical to an undisturbed run."""
 
     rid: int
     prompt: np.ndarray  # [Tp] int32
     bucket: int
-    max_new: int
+    max_new: int  # TOTAL new-token budget (not remaining)
     eos_id: int | None
     greedy: bool
     t: float
     k: int
     p: float
-    keydata: np.ndarray  # key-impl uint32 words
+    keydata: np.ndarray  # base key-impl uint32 words (decode folds these)
+    prefill_keydata: np.ndarray  # key for the admission prefill's draw
+    deadline: float | None = None  # engine-clock absolute deadline
+    gen: list = dataclasses.field(default_factory=list)  # resume prefix
+    retries: int = 0  # fault-resume count (dispatch failures)
+    nan_retried: bool = False  # quarantine: one retry, then FAILED
 
 
 @dataclasses.dataclass
@@ -642,6 +722,9 @@ class _Slot:
     k: int
     p: float
     keydata: np.ndarray
+    deadline: float | None = None
+    retries: int = 0
+    nan_retried: bool = False
 
 
 class BatchedDecodeEngine:
@@ -695,6 +778,29 @@ class BatchedDecodeEngine:
     Not thread-safe (single dispatcher per engine); requests are
     single-sequence (one row each — batch your own beams as separate
     requests).
+
+    **Request lifecycle + fault model** (docs/ROBUSTNESS.md): every
+    request reaches exactly one terminal ``RequestResult`` state —
+    DONE / FAILED / ABORTED / EXPIRED — delivered via ``pop_result``.
+    Per-request deadlines (``submit(timeout_s=...)``) expire queued AND
+    mid-decode requests with their clean partial output; ``abort(rid)``
+    retires a slot row mid-decode as pure host bookkeeping (traced
+    shapes untouched — no recompile, neighbours unperturbed); the
+    admission queue is bounded (``queue_limit`` + reject-loudly or
+    block-with-timeout backpressure). Both compiled programs return a
+    traced NaN/Inf logit sentinel next to their tokens; a poisoned row
+    is QUARANTINED (freed, requeued, its prefix re-prefilled over a
+    fresh row — neighbours keep decoding untouched), retried once, then
+    FAILED. A failed/dropped dispatch consumed the donated cache, so
+    EVERY in-flight row converts to a resume entry (tokens-so-far +
+    pre-folded PRNG schedule) and is re-prefilled on the next tick —
+    bounded by per-request ``request_retries`` and engine-level
+    consecutive ``dispatch_retries`` with exponential backoff.
+    ``snapshot()`` captures that same host state at any tick boundary;
+    ``restore()`` on a rebuilt engine after device loss re-prefills
+    every in-flight request and continues token-identically. The
+    deterministic fault-injection harness (serving/chaos.py) drives all
+    of these paths in tests and scripts/soak.py.
     """
 
     # The donated cache's positional index in each program signature.
@@ -709,6 +815,13 @@ class BatchedDecodeEngine:
         buckets: BucketSpec | None = None,
         mesh_cfg: MeshConfig | None = None,
         prefill_groups: tuple[int, ...] | None = None,
+        queue_limit: int | None = None,
+        backpressure: str = "reject",
+        request_retries: int = 3,
+        dispatch_retries: int | None = 2,
+        retry_backoff_s: float = 0.05,
+        clock=None,
+        sleep=None,
     ) -> None:
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -775,12 +888,48 @@ class BatchedDecodeEngine:
         # without this identity memo (the serial engine pays it once per
         # request; holding the source keeps its id from being recycled).
         self._placed: tuple[Any, Any] | None = None
-        self.results: dict[int, np.ndarray] = {}
-        self.aborted: set[int] = set()
+        self.results: dict[int, RequestResult] = {}
+
+        # -- robustness layer (see class docstring) ---------------------
+        if backpressure not in ("reject", "block"):
+            raise ValueError(
+                f"backpressure must be 'reject' or 'block', got "
+                f"{backpressure!r}"
+            )
+        if queue_limit is not None and queue_limit < 1:
+            raise ValueError(f"queue_limit must be >= 1, got {queue_limit}")
+        self.queue_limit = queue_limit
+        self.backpressure = backpressure
+        self.request_retries = int(request_retries)
+        self.dispatch_retries = dispatch_retries
+        self.retry_backoff_s = float(retry_backoff_s)
+        # Injectable time sources: the chaos harness (serving/chaos.py)
+        # substitutes a VirtualClock so deadlines, backoff, and slow-tick
+        # faults are DETERMINISTIC; production uses the monotonic clock.
+        self._clock = clock or time.monotonic
+        self._sleep = sleep or time.sleep
+        self._injector = None  # serving/chaos.FaultInjector (or None)
+        self._ticks = 0
+        self._fail_streak = 0  # consecutive failed dispatches
+        # Prefill shapes the engine may dispatch: the user buckets plus
+        # max_len — fault-resume prefixes (prompt + tokens-so-far) can
+        # exceed the largest PROMPT bucket, and the extra bucket keeps
+        # them inside the warmed, finite compile set (fresh submissions
+        # still obey the user BucketSpec contract unchanged).
+        pb = tuple(self.buckets.buckets)
+        if pb and pb[-1] < self.max_len:
+            pb = pb + (self.max_len,)
+        self._prefill_buckets = pb  # () = exact-length mode
+        self.stats: dict[str, int] = {
+            "done": 0, "failed": 0, "aborted": 0, "expired": 0,
+            "nan_quarantines": 0, "dispatch_failures": 0, "resumes": 0,
+            "cache_allocs": 0,
+        }
 
     # -- cache -------------------------------------------------------------
 
     def _new_cache(self) -> decode.Cache:
+        self.stats["cache_allocs"] += 1
         if self.mode == "tp":
             full = decode.init_cache(self.cfg, self.slots, self.max_len)
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -811,7 +960,12 @@ class BatchedDecodeEngine:
     def _bodies(self):
         """The two raw program bodies. All sampling state is per-row and
         traced; ``rows``/``pos``/``folds`` are traced index vectors, so
-        one compiled shape covers every admission/retirement pattern."""
+        one compiled shape covers every admission/retirement pattern.
+        Both return a [B] traced non-finite-logits sentinel
+        (``decode.nonfinite_rows`` over the sampled position) — the
+        scheduler quarantines flagged rows; elementwise + one reduction,
+        so the pinned collective budgets (registry:
+        decode_batched_step_tp all-reduce=2) are untouched by it."""
 
         def prefill(params, prompts, plens, rows, cache,
                     greedy, t, k, p, keydata):
@@ -829,18 +983,17 @@ class BatchedDecodeEngine:
             cache = {
                 kk: cache[kk].at[:, rows].set(seg[kk]) for kk in cache
             }
-            return tok, cache
+            return tok, decode.nonfinite_rows(last), cache
 
         def decode_step(params, toks, cache, pos, folds,
                         greedy, t, k, p, keydata):
             logits, cache = self._forward(params, toks[:, None], cache, pos)
+            last = logits[:, -1]
             keys = jax.vmap(jax.random.fold_in)(
                 jax.random.wrap_key_data(keydata), folds
             )
-            tok = decode.sample_token_rows(
-                logits[:, -1], greedy, t, keys, k, p
-            )
-            return tok, cache
+            tok = decode.sample_token_rows(last, greedy, t, keys, k, p)
+            return tok, decode.nonfinite_rows(last), cache
 
         return {"prefill": prefill, "decode_step": decode_step}
 
@@ -880,7 +1033,7 @@ class BatchedDecodeEngine:
                 body,
                 mesh=self._mesh,
                 in_specs=specs,
-                out_specs=(P(), cache_spec),
+                out_specs=(P(), P(), cache_spec),
                 check_vma=True,
             )
             prog = jax.jit(smapped, donate_argnums=donate)
@@ -908,14 +1061,24 @@ class BatchedDecodeEngine:
         top_k: int | None = None,
         top_p: float | None = None,
         eos_id: int | None = None,
+        timeout_s: float | None = None,
+        params=None,
+        block_timeout_s: float | None = None,
     ) -> int:
         """Queue one single-sequence request ([Tp] or [1, Tp] int ids);
         returns its request id. The request is admitted into a free slot
-        by a later ``step``; its output (prompt + generated ids, cut at
-        ``eos_id`` if hit) lands in ``self.results[rid]`` — collect it
-        with ``pop_result(rid)`` (long-lived engines leak host memory
-        otherwise). Backpressure is the queue itself: submissions beyond
-        the slot count simply wait their FIFO turn."""
+        by a later ``step``; its terminal ``RequestResult`` lands in
+        ``self.results[rid]`` — collect it with ``pop_result(rid)``
+        (long-lived engines leak host memory otherwise).
+
+        ``timeout_s``: per-request deadline on the ENGINE clock; a
+        request still queued or mid-decode when it passes retires
+        EXPIRED with its clean partial output. Backpressure: with no
+        ``queue_limit`` the queue itself is the backpressure (submissions
+        beyond the slot count wait their FIFO turn); with one, the
+        ``reject`` policy raises ``AdmissionQueueFull`` loudly, and the
+        ``block`` policy drives the scheduler (``params`` required) until
+        space frees or ``block_timeout_s`` passes, then raises."""
         prompt = np.asarray(prompt)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -925,28 +1088,14 @@ class BatchedDecodeEngine:
                 f"(one slot row); got prompt shape {prompt.shape}"
             )
         tp = prompt.shape[0]
-        if tp == 0:
-            raise ValueError(
-                "empty prompt: need at least one token to prefill (an "
-                "empty prompt would sample the first token from a pad "
-                "position's logits)"
-            )
-        if max_new_tokens < 0:
-            raise ValueError(
-                f"max_new_tokens must be >= 0, got {max_new_tokens}"
-            )
-        if tp + max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt ({tp}) + max_new_tokens ({max_new_tokens}) "
-                f"exceeds the engine max_len {self.max_len}"
-            )
-        if temperature > 0.0 and key is None:
-            raise ValueError("temperature sampling requires a PRNG key")
+        decode._check_sample_args(
+            prompt, max_new_tokens, temperature, key, max_len=self.max_len
+        )
+        if timeout_s is not None and timeout_s <= 0:
+            raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        self._admission_backpressure(params, block_timeout_s)
         rid = self._next_rid
         self._next_rid += 1
-        if max_new_tokens == 0:
-            self.results[rid] = prompt.astype(np.int32)
-            return rid
         bucket = self.buckets.bucket_for(tp)
         t, k, p = decode.sampling_scalars(
             temperature, top_k, top_p, self.cfg.vocab_size
@@ -956,13 +1105,53 @@ class BatchedDecodeEngine:
             if key is not None
             else np.zeros((self._key_words,), np.uint32)
         )
+        deadline = (
+            None if timeout_s is None else self._clock() + timeout_s
+        )
         self._queue.append(_Pending(
             rid=rid, prompt=prompt.astype(np.int32), bucket=bucket,
             max_new=int(max_new_tokens), eos_id=eos_id,
             greedy=not temperature > 0.0,
             t=float(t), k=int(k), p=float(p), keydata=keydata,
+            prefill_keydata=keydata, deadline=deadline,
         ))
+        log_event(
+            "submit", rid=rid, t=round(self._clock(), 6), prompt_len=tp,
+            max_new=int(max_new_tokens),
+            deadline=None if deadline is None else round(deadline, 6),
+        )
         return rid
+
+    def _admission_backpressure(self, params, block_timeout_s) -> None:
+        if self.queue_limit is None or len(self._queue) < self.queue_limit:
+            return
+        if self.backpressure == "reject":
+            raise AdmissionQueueFull(
+                f"admission queue full: {len(self._queue)} queued >= "
+                f"queue_limit {self.queue_limit} (policy 'reject') — "
+                "shed load upstream or retry after draining"
+            )
+        # block: drive the scheduler until space frees or timeout.
+        if params is None:
+            raise ValueError(
+                "backpressure policy 'block' drives the scheduler from "
+                "submit and therefore needs params=... (or use the "
+                "'reject' policy)"
+            )
+        deadline = (
+            None
+            if block_timeout_s is None
+            else self._clock() + block_timeout_s
+        )
+        while len(self._queue) >= self.queue_limit:
+            if deadline is not None and self._clock() >= deadline:
+                raise AdmissionQueueFull(
+                    f"admission queue still full ({len(self._queue)} >= "
+                    f"queue_limit {self.queue_limit}) after blocking "
+                    f"{block_timeout_s}s — the engine is not draining "
+                    "fast enough for the offered load"
+                )
+            self.step(params)
 
     def has_work(self) -> bool:
         return bool(self._queue) or any(
@@ -975,72 +1164,319 @@ class BatchedDecodeEngine:
     def active_rids(self) -> list[int]:
         return [s.rid for s in self._slots if s is not None]
 
+    def abort(self, rid: int) -> bool:
+        """Cancel one request mid-flight. Pure host bookkeeping: a
+        queued entry is removed, an ACTIVE slot row is freed (its K/V
+        stays in place, dirty — the traced shapes and the compiled
+        programs are untouched, so an abort can never recompile and
+        neighbours decode on unperturbed). The request retires ABORTED
+        with its clean partial output. Returns True on transition, False
+        if the request already reached a terminal state; unknown rids
+        raise KeyError."""
+        for q in self._queue:
+            if q.rid == rid:
+                self._queue.remove(q)
+                self._finish_pending(q, ABORTED, "abort() while queued")
+                return True
+        for i, s in enumerate(self._slots):
+            if s is not None and s.rid == rid:
+                self._slots[i] = None
+                self._finish_slot(s, ABORTED, "abort() mid-decode")
+                return True
+        if rid in self.results:
+            return False
+        raise KeyError(
+            f"unknown rid {rid}: never submitted, or already delivered "
+            "via pop_result"
+        )
+
     def step(self, params) -> list[int]:
-        """One scheduler tick: admit queued requests into free slots
-        (prefill), then advance every active row one token (one batched
-        decode dispatch). Returns the rids that finished this tick."""
+        """One scheduler tick: expire overdue requests, admit queued
+        requests into free slots (prefill), then advance every active
+        row one token (one batched decode dispatch). Returns the rids
+        that reached a terminal state this tick.
+
+        A failed/dropped dispatch is RECOVERED here, not surfaced: every
+        in-flight row converts to a resume entry (re-prefilled from its
+        tokens-so-far on a later tick), bounded by per-request
+        ``request_retries``; only when ``dispatch_retries`` CONSECUTIVE
+        dispatches fail does step raise ``DispatchFailure`` — with the
+        engine state still consistent (everything requeued)."""
+        self._ticks += 1
+        if self._injector is not None:
+            self._injector.on_tick(self._ticks)
         params = self._place_params(params)
         finished: list[int] = []
+        self._expire(finished)
         self._admit(params, finished)
         if any(s is not None for s in self._slots):
             self._decode_tick(params, finished)
         return finished
 
-    def run(self, params, requests=None) -> dict[int, np.ndarray]:
+    def run(
+        self, params, requests=None, *,
+        max_ticks: int | None = None,
+        timeout_s: float | None = None,
+    ) -> dict[int, RequestResult]:
         """Submit ``requests`` (iterable of ``submit`` kwarg dicts), then
-        drive ``step`` until idle. Returns {rid: tokens} for everything
-        completed during the drive (including previously queued work)."""
+        drive ``step`` until idle. Returns {rid: RequestResult} for
+        everything that reached a terminal state during the drive
+        (including previously queued work).
+
+        ``max_ticks`` / ``timeout_s`` (engine clock) bound the drive: a
+        hung or permanently-faulting stream terminates with the partial
+        results collected so far (remaining work stays queued/active in
+        the engine) instead of looping forever."""
         before = set(self.results)
         for req in requests or ():
             self.submit(**req)
+        deadline = (
+            None if timeout_s is None else self._clock() + timeout_s
+        )
+        ticks = 0
         while self.has_work():
+            if max_ticks is not None and ticks >= max_ticks:
+                log_event(
+                    "run_guard", reason="max_ticks", ticks=ticks,
+                    queued=len(self._queue),
+                    active=len(self.active_rids()),
+                )
+                break
+            if deadline is not None and self._clock() >= deadline:
+                log_event(
+                    "run_guard", reason="timeout", ticks=ticks,
+                    queued=len(self._queue),
+                    active=len(self.active_rids()),
+                )
+                break
             self.step(params)
+            ticks += 1
         return {
             rid: out for rid, out in self.results.items()
             if rid not in before
         }
 
-    def pop_result(self, rid: int) -> np.ndarray | None:
-        """Deliver and RELEASE one request's output: returns the tokens
-        (``None`` if the request was aborted by a failed dispatch) and
-        drops the engine's reference. A long-lived engine retains every
-        retired request's output in ``results`` (and aborted rids in
-        ``aborted``) until delivered — serving loops must pop (or ``del``)
-        what they consume, or host memory grows per request forever."""
-        if rid in self.aborted:
-            self.aborted.discard(rid)
-            return None
+    def pop_result(self, rid: int) -> RequestResult:
+        """Deliver and RELEASE one request's terminal ``RequestResult``
+        (state DONE/FAILED/ABORTED/EXPIRED + tokens + reason), dropping
+        the engine's reference. A long-lived engine retains every
+        retired request's result in ``results`` until delivered —
+        serving loops must pop (or ``del``) what they consume, or host
+        memory grows per request forever. KeyError for unknown or
+        not-yet-terminal rids."""
         return self.results.pop(rid)
 
     def warmup(self, params) -> int:
         """Compile every (bucket x prefill-group) shape plus the decode
         program with dummy dispatches (idle engines only — warmup writes
         garbage rows), so a serving loop's steady state starts
-        compile-free. Returns compile_count()."""
+        compile-free. Covers the fault-resume max_len bucket too, so
+        recovery re-prefills never compile mid-incident. Returns
+        compile_count()."""
         if self.has_work():
             raise RuntimeError("warmup requires an idle engine")
-        if not self.buckets.buckets:
+        if not self._prefill_buckets:
             raise ValueError(
                 "warmup needs a finite BucketSpec (exact-length mode "
                 "compiles per observed prompt length)"
             )
         params = self._place_params(params)
-        for bucket in self.buckets.buckets:
+        for bucket in self._prefill_buckets:
             for g in self._groups:
                 args = self.example_args(
                     "prefill", params, bucket=bucket, group=g,
                     cache=self._take_cache(),
                 )
-                _, cache = self.program("prefill")(*args)
+                _, _, cache = self.program("prefill")(*args)
                 self._cache = cache
         args = self.example_args(
             "decode_step", params, cache=self._take_cache()
         )
-        _, cache = self.program("decode_step")(*args)
+        _, _, cache = self.program("decode_step")(*args)
         self._cache = cache
         return self.compile_count()
 
+    # -- fault injection / crash recovery ------------------------------------
+
+    def set_fault_injector(self, injector) -> None:
+        """Install a serving/chaos.FaultInjector (or None to remove):
+        host-side hooks consulted around every dispatch and at every
+        tick — nothing traced ever sees it, so injection cannot change
+        compiled programs or their budgets."""
+        self._injector = injector
+        if injector is not None:
+            # Seeded nan_row faults pick their target among the active
+            # rows, so the injector needs the engine back-reference
+            # whichever way it was attached (here or injector.install).
+            injector._engine = self
+
+    def snapshot(self) -> EngineSnapshot:
+        """Capture the engine's full host-side request state (between
+        ``step`` calls): queued entries, every in-flight row as a resume
+        entry carrying its tokens-so-far and pre-folded PRNG schedule,
+        the rid counter, and undelivered results. Device state (the KV
+        cache) is deliberately NOT captured — it is reconstructible from
+        the prefixes, which is exactly what ``restore`` + the admission
+        path do."""
+        inflight = [
+            self._pending_from_slot(s, bump=False)
+            for s in self._slots if s is not None
+        ]
+        inflight.sort(key=lambda q: q.rid)
+        queued = [
+            dataclasses.replace(q, gen=list(q.gen)) for q in self._queue
+        ]
+        log_event(
+            "snapshot", t=round(self._clock(), 6),
+            inflight=len(inflight), queued=len(queued),
+        )
+        return EngineSnapshot(
+            pending=inflight + queued,
+            next_rid=self._next_rid,
+            results=dict(self.results),
+            stats=dict(self.stats),
+        )
+
+    def restore(self, snap: EngineSnapshot) -> None:
+        """Load a ``snapshot`` into this (fresh, idle) engine — the
+        crash-recovery path: after a device loss kills the old engine
+        (and its donated cache), a rebuilt engine restores and its next
+        ``step``s re-prefill every in-flight request from its
+        tokens-so-far, continuing token-identically to an uninterrupted
+        run (the per-request fold schedule rides in the entries).
+        Buckets are recomputed against THIS engine's spec, so the
+        snapshot survives a bucket-config change on rebuild."""
+        if self.has_work() or self.results:
+            raise RuntimeError(
+                "restore requires a fresh idle engine (no queued/active "
+                "work, no undelivered results)"
+            )
+        self._next_rid = snap.next_rid
+        self.results.update(snap.results)
+        for q in snap.pending:
+            prefix = len(q.prompt) + len(q.gen)
+            if prefix + (q.max_new - len(q.gen)) > self.max_len:
+                raise ValueError(
+                    f"snapshot entry rid {q.rid} needs "
+                    f"{prefix + q.max_new - len(q.gen)} cache positions "
+                    f"but this engine's max_len is {self.max_len}"
+                )
+            bucket = (
+                self._resume_bucket(prefix)
+                if q.gen
+                else self.buckets.bucket_for(len(q.prompt))
+            )
+            self._queue.append(
+                dataclasses.replace(q, bucket=bucket, gen=list(q.gen))
+            )
+        log_event(
+            "restore", t=round(self._clock(), 6),
+            pending=len(snap.pending), next_rid=snap.next_rid,
+        )
+
     # -- scheduler internals -----------------------------------------------
+
+    def _resume_bucket(self, length: int) -> int:
+        """Smallest warmed prefill shape covering a resume prefix (the
+        user buckets extended by max_len; exact length in exact mode)."""
+        for b in self._prefill_buckets:
+            if b >= length:
+                return b
+        return length
+
+    def _prefill_keydata(self, req_keydata, g: int, greedy: bool):
+        """The key the admission prefill must draw with so a resumed
+        request's next token bit-matches the undisturbed run: token g of
+        a request is sampled with fold_in(base_key, g - 1) (g = 0: the
+        unfolded base key). Folded HOST-side — a rare, tiny dispatch —
+        so the compiled prefill keeps its one uniform signature."""
+        if greedy or g == 0:
+            return req_keydata
+        key = jax.random.wrap_key_data(jnp.asarray(req_keydata))
+        return np.asarray(
+            jax.random.key_data(jax.random.fold_in(key, g - 1))
+        )
+
+    def _pending_from_slot(
+        self, s: _Slot, *, bump: bool, nan_retried: bool | None = None
+    ) -> _Pending:
+        """Convert an in-flight row to a resume entry: the clean tokens
+        generated so far become the prefill prefix; ``bump`` charges one
+        fault-resume against the request's retry budget."""
+        g = len(s.generated)
+        prefix = len(s.prompt) + g
+        return _Pending(
+            rid=s.rid, prompt=s.prompt, bucket=self._resume_bucket(prefix),
+            max_new=s.max_new, eos_id=s.eos_id, greedy=s.greedy,
+            t=s.t, k=s.k, p=s.p, keydata=s.keydata,
+            prefill_keydata=self._prefill_keydata(s.keydata, g, s.greedy),
+            deadline=s.deadline, gen=list(s.generated),
+            retries=s.retries + (1 if bump else 0),
+            nan_retried=s.nan_retried if nan_retried is None else nan_retried,
+        )
+
+    def _partial_tokens(self, prompt, gen) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(prompt, np.int32), np.asarray(gen, np.int32)]
+        )
+
+    def _finish(self, rid, state, tokens, reason,
+                finished: list[int] | None = None) -> None:
+        self.results[rid] = RequestResult(
+            rid=rid, state=state, tokens=tokens, reason=reason
+        )
+        self.stats[state.lower()] += 1
+        if finished is not None:
+            finished.append(rid)
+        log_event(
+            "retire", rid=rid, state=state, t=round(self._clock(), 6),
+            n_tokens=len(tokens), reason=reason or None,
+        )
+
+    def _finish_pending(self, q: _Pending, state, reason,
+                        finished=None) -> None:
+        self._finish(
+            q.rid, state, self._partial_tokens(q.prompt, q.gen), reason,
+            finished,
+        )
+
+    def _finish_slot(self, s: _Slot, state, reason, finished=None) -> None:
+        self._finish(
+            s.rid, state, self._partial_tokens(s.prompt, s.generated),
+            reason, finished,
+        )
+
+    def _requeue(self, pendings) -> None:
+        """Merge resume/rewound entries back into the admission queue in
+        ascending-rid order — rids are assigned at submit, so rid order
+        IS global FIFO order: a resumed old request re-admits before
+        younger traffic, keeping scheduling deterministic under faults."""
+        if not pendings:
+            return
+        items = sorted(
+            list(self._queue) + list(pendings), key=lambda q: q.rid
+        )
+        self._queue = collections.deque(items)
+
+    def _expire(self, finished: list[int]) -> None:
+        now = self._clock()
+        overdue = [
+            q for q in self._queue
+            if q.deadline is not None and now >= q.deadline
+        ]
+        for q in overdue:
+            self._queue.remove(q)
+            self._finish_pending(
+                q, EXPIRED,
+                f"deadline passed at t={now:.3f} while queued", finished,
+            )
+        for i, s in enumerate(self._slots):
+            if s is not None and s.deadline is not None and now >= s.deadline:
+                self._slots[i] = None
+                self._finish_slot(
+                    s, EXPIRED,
+                    f"deadline passed at t={now:.3f} mid-decode", finished,
+                )
 
     def _admit(self, params, finished: list[int]) -> None:
         free = [i for i, s in enumerate(self._slots) if s is None]
@@ -1055,10 +1491,22 @@ class BatchedDecodeEngine:
             by_bucket.setdefault(req.bucket, []).append(
                 (req, free.pop(0))
             )
-        for bucket, group in by_bucket.items():
-            self._prefill_group(params, bucket, group, finished)
+        groups = list(by_bucket.items())
+        for gi, (bucket, group) in enumerate(groups):
+            if not self._prefill_group(params, bucket, group, finished):
+                # Dispatch failed: recovery requeued this group and every
+                # in-flight row; rewind the not-yet-dispatched groups
+                # untouched (no retry charge — they were never at risk)
+                # and stop admitting this tick.
+                rest = [
+                    pend for _, g in groups[gi + 1:] for pend, _ in g
+                ]
+                self._requeue(rest)
+                return
 
-    def _prefill_group(self, params, bucket, group, finished) -> None:
+    def _prefill_group(self, params, bucket, group, finished) -> bool:
+        """One bucket's admission dispatch. Returns False when the
+        dispatch failed (recovery already ran)."""
         n = len(group)
         npad = next(g for g in self._groups if g >= n)
         # Pad the group by DUPLICATING entry 0 (same row index, same
@@ -1075,26 +1523,41 @@ class BatchedDecodeEngine:
         keydata = np.zeros((npad, self._key_words), np.uint32)
         for j, i in enumerate(idx):
             req, row = group[i]
-            prompts[j, : req.prompt.shape[0]] = req.prompt
-            plens[j] = req.prompt.shape[0]
+            prefix = self._partial_tokens(req.prompt, req.gen)
+            prompts[j, : prefix.shape[0]] = prefix
+            plens[j] = prefix.shape[0]
             rows[j] = row
             greedy[j] = req.greedy
             t[j], k[j], p[j] = req.t, req.k, req.p
-            keydata[j] = req.keydata
-        toks = self._dispatch(
-            "prefill", params, jnp.asarray(prompts), jnp.asarray(plens),
+            keydata[j] = req.prefill_keydata
+        res = self._dispatch(
+            "prefill", params, [req for req, _ in group], finished,
+            jnp.asarray(prompts), jnp.asarray(plens),
             jnp.asarray(rows), None, jnp.asarray(greedy), jnp.asarray(t),
             jnp.asarray(k), jnp.asarray(p), jnp.asarray(keydata),
         )
-        toks = np.asarray(toks)
+        if res is None:
+            return False
+        toks, bad = res
         for i, (req, row) in enumerate(group):
+            if bad[i]:
+                self._quarantine_pending(req, finished)
+                continue
             self._slots[row] = _Slot(
                 rid=req.rid, prompt=req.prompt, max_new=req.max_new,
-                eos_id=req.eos_id, pos=int(plens[i]), fold=0,
-                generated=[int(toks[i])], greedy=req.greedy,
-                t=req.t, k=req.k, p=req.p, keydata=req.keydata,
+                eos_id=req.eos_id, pos=int(plens[i]), fold=len(req.gen),
+                generated=list(req.gen) + [int(toks[i])],
+                greedy=req.greedy, t=req.t, k=req.k, p=req.p,
+                keydata=req.keydata, deadline=req.deadline,
+                retries=req.retries, nan_retried=req.nan_retried,
+            )
+            log_event(
+                "admit", rid=req.rid, row=row, bucket=bucket,
+                resume_prefix=len(req.gen) or None,
+                t=round(self._clock(), 6),
             )
             self._maybe_retire(row, finished)
+        return True
 
     def _decode_tick(self, params, finished: list[int]) -> None:
         b = self.slots
@@ -1115,39 +1578,148 @@ class BatchedDecodeEngine:
             greedy[i] = s.greedy
             t[i], k[i], p[i] = s.t, s.k, s.p
             keydata[i] = s.keydata
-        out = self._dispatch(
-            "decode_step", params, jnp.asarray(toks), None,
-            jnp.asarray(pos), jnp.asarray(folds), jnp.asarray(greedy),
-            jnp.asarray(t), jnp.asarray(k), jnp.asarray(p),
-            jnp.asarray(keydata),
+        res = self._dispatch(
+            "decode_step", params, None, finished, jnp.asarray(toks),
+            None, jnp.asarray(pos), jnp.asarray(folds),
+            jnp.asarray(greedy), jnp.asarray(t), jnp.asarray(k),
+            jnp.asarray(p), jnp.asarray(keydata),
         )
-        out = np.asarray(out)
+        if res is None:
+            return
+        out, bad = res
         for i, s in enumerate(self._slots):
             if s is None:
+                continue
+            if bad[i]:
+                self._slots[i] = None
+                self._quarantine_slot(s, i, finished)
                 continue
             s.generated.append(int(out[i]))
             s.pos += 1
             s.fold += 1
             self._maybe_retire(i, finished)
 
-    def _dispatch(self, kind, params, *args):
+    def _quarantine_pending(self, req: _Pending, finished) -> None:
+        """Non-finite logits in an admission prefill: the garbage token
+        is discarded and the request retried once over a freshly
+        re-prefilled row, then FAILED."""
+        self.stats["nan_quarantines"] += 1
+        if req.nan_retried:
+            self._finish_pending(
+                req, FAILED,
+                "non-finite logits persisted after one quarantine retry "
+                "(prefill)", finished,
+            )
+            return
+        log_event(
+            "quarantine", rid=req.rid, phase="prefill",
+            t=round(self._clock(), 6),
+        )
+        self._requeue([dataclasses.replace(
+            req, gen=list(req.gen), nan_retried=True
+        )])
+
+    def _quarantine_slot(self, s: _Slot, row: int, finished) -> None:
+        """Non-finite logits on an active row mid-decode: free the row
+        (neighbours untouched — per-row masking means its re-prefill
+        reads only what it rewrites), requeue its CLEAN prefix for one
+        fresh re-prefill, then FAILED on recurrence."""
+        self.stats["nan_quarantines"] += 1
+        if s.nan_retried:
+            self._finish_slot(
+                s, FAILED,
+                "non-finite logits persisted after one quarantine retry "
+                "(decode)", finished,
+            )
+            return
+        log_event(
+            "quarantine", rid=s.rid, phase="decode", row=row,
+            t=round(self._clock(), 6),
+        )
+        self._requeue([
+            self._pending_from_slot(s, bump=False, nan_retried=True)
+        ])
+
+    def _dispatch(self, kind, params, group_pendings, finished, *args):
         """Run ``kind`` with the engine cache spliced in at its donated
-        argnum. A failed dispatch consumed the donated buffer, so the
-        cache is dropped AND every in-flight row is aborted (its K/V is
-        gone) — queued requests survive and admit into the fresh cache."""
+        argnum, consulting the fault injector around the call. Returns
+        (tokens, bad) as host arrays, or None after a RECOVERED failure.
+
+        Any failure — the program raising, or the result dropped in
+        transit — consumed the donated cache, so every in-flight row's
+        K/V is gone: recovery converts them ALL to resume entries
+        (re-prefilled from tokens-so-far on a later tick), charges one
+        retry against each, and backs off exponentially; queued requests
+        are untouched. ``dispatch_retries`` consecutive failures raise
+        ``DispatchFailure`` with the state already consistent."""
         cache_at = self.CACHE_ARGNUM[kind] - 1  # args exclude params here
         args = list(args)
         args[cache_at] = self._take_cache()
+        inj = self._injector
         try:
-            out, cache = self.program(kind)(params, *args)
-        except BaseException:
-            for i, s in enumerate(self._slots):
-                if s is not None:
-                    self.aborted.add(s.rid)
-                    self._slots[i] = None
-            raise
+            if inj is not None:
+                inj.before_dispatch(kind, self._ticks)
+            tok, bad, cache = self.program(kind)(params, *args)
+            if inj is not None:
+                tok, bad = inj.after_dispatch(kind, self._ticks, tok, bad)
+        except Exception as err:
+            # Exception, not BaseException: KeyboardInterrupt/SystemExit
+            # must abort the serving loop, not masquerade as a transient
+            # device fault and get retried.
+            self._recover_dispatch_failure(
+                kind, err, group_pendings or [], finished
+            )
+            return None
         self._cache = cache
-        return out
+        self._fail_streak = 0
+        return np.asarray(tok), np.asarray(bad)
+
+    def _recover_dispatch_failure(self, kind, err, group_pendings,
+                                  finished) -> None:
+        self.stats["dispatch_failures"] += 1
+        self._fail_streak += 1
+        log_event(
+            "dispatch_fail", kind=kind, tick=self._ticks,
+            streak=self._fail_streak, error=type(err).__name__,
+            t=round(self._clock(), 6),
+        )
+        lost = [
+            self._pending_from_slot(s, bump=True)
+            for s in self._slots if s is not None
+        ]
+        self._slots = [None] * self.slots
+        lost += [
+            dataclasses.replace(q, gen=list(q.gen), retries=q.retries + 1)
+            for q in group_pendings
+        ]
+        kept = []
+        for q in lost:
+            if q.retries > self.request_retries:
+                self._finish_pending(
+                    q, FAILED,
+                    f"dispatch failed ({type(err).__name__}) and the "
+                    f"request exhausted its {self.request_retries} "
+                    "fault-resume retries", finished,
+                )
+            else:
+                self.stats["resumes"] += 1
+                kept.append(q)
+        self._requeue(kept)
+        if (
+            self.dispatch_retries is not None
+            and self._fail_streak > self.dispatch_retries
+        ):
+            raise DispatchFailure(
+                f"{self._fail_streak} consecutive dispatch failures "
+                f"(> dispatch_retries {self.dispatch_retries}); engine "
+                "state is consistent — every in-flight request was "
+                "requeued (or FAILED past its retry budget); snapshot() "
+                "and rebuild, or step again later"
+            ) from err
+        if self._fail_streak > 0 and self.retry_backoff_s > 0:
+            self._sleep(
+                self.retry_backoff_s * (2 ** (self._fail_streak - 1))
+            )
 
     def _maybe_retire(self, row: int, finished: list[int]) -> None:
         s = self._slots[row]
@@ -1156,11 +1728,8 @@ class BatchedDecodeEngine:
             return
         # Retirement is pure host bookkeeping: the row's K/V stays in
         # place (dirty) and the next admission masks it out.
-        self.results[s.rid] = np.concatenate(
-            [s.prompt, np.asarray(s.generated, np.int32)]
-        )
         self._slots[row] = None
-        finished.append(s.rid)
+        self._finish_slot(s, DONE, "", finished)
 
     # -- introspection -----------------------------------------------------
 

@@ -31,6 +31,28 @@ def get_logger(name: str = "pdtpu") -> logging.Logger:
     return logger
 
 
+def log_event(
+    event: str, *, logger: logging.Logger | None = None, **fields
+) -> None:
+    """One structured lifecycle line: ``event=<name> key=value ...`` with
+    keys sorted and Nones dropped, so serving-engine incidents (soak
+    failures, chaos runs) are diagnosable — and greppable — from the log
+    alone. Emitted at DEBUG on the ``pdtpu.serving`` child logger:
+    lifecycle events are per-request bookkeeping, not operator output;
+    enable with ``get_logger("pdtpu.serving").setLevel(logging.DEBUG)``
+    (scripts/soak.py tees them to a file). Host-side only — never call
+    from traced code (repolint's host-sync rule would flag the formatting
+    anyway)."""
+    lg = logger or get_logger("pdtpu.serving")
+    if lg.isEnabledFor(logging.DEBUG):
+        parts = [f"event={event}"] + [
+            f"{k}={fields[k]}"
+            for k in sorted(fields)
+            if fields[k] is not None
+        ]
+        lg.debug(" ".join(parts))
+
+
 def is_process_zero() -> bool:
     return jax.process_index() == 0
 

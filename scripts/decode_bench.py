@@ -26,6 +26,14 @@ per-request p50/p99 latency derived from the SAME per-request completion
 timestamps, and the steady-state compile count of each leg (expected 0).
 Artifact: benchmarks/serving_batched_bench.json.
 
+``--serving-batched --chaos`` adds the ROBUSTNESS leg: the same seeded
+arrival stream replayed twice through the batched engine — once clean,
+once under a SEEDED fault schedule (serving/chaos.py: dispatch failures,
+dropped results, NaN-poisoned rows) — reporting goodput (DONE tokens
+only), p50/p99 INCLUDING retry/resume inflation, fault counts, and the
+steady-state compile count (still expected 0: recovery re-prefills ride
+warmed shapes). Artifact: benchmarks/serving_chaos_bench.json.
+
 Usage:
   python scripts/decode_bench.py                    # gpt2 + llama3-1b
   python scripts/decode_bench.py --preset gpt2 --batch 8
@@ -698,6 +706,184 @@ def bench_serving_batched(args) -> list[dict]:
     return [row]
 
 
+def bench_serving_chaos(args) -> list[dict]:
+    """The robustness cost of surviving faults, measured: one seeded
+    mixed-length arrival stream through the batched engine twice —
+    clean, then under a seeded fault schedule (dispatch failures eat the
+    donated cache and force every in-flight row to re-prefill; dropped
+    results pay the compute AND the recovery; NaN rows quarantine and
+    retry one row) — with BOTH legs' latencies from the same per-request
+    completion-timestamp discipline as ``--serving-batched``. Goodput
+    counts DONE tokens only; p50/p99 on the chaos leg include every
+    retry and resume. The fault schedule is a pure function of
+    ``--chaos-seed`` (the arrival stream too), so the committed artifact
+    is reproducible. Wall-clock time drives the engine (production
+    clock); slow-tick/deadline faults live in scripts/soak.py where the
+    VirtualClock makes them deterministic."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.serving.chaos import FaultInjector
+    from pytorch_distributed_tpu.serving.engine import (
+        BatchedDecodeEngine,
+        BucketSpec,
+    )
+    from pytorch_distributed_tpu.serving.lifecycle import DONE
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _serving_cfg(args.dryrun)
+    slots = 4 if args.dryrun else 8
+    max_new = 12 if args.dryrun else 32
+    max_len = 160 if args.dryrun else 384
+    n_req = 16 if args.dryrun else 48
+    buckets = BucketSpec.powers_of_two(
+        max_len - max_new, min_bucket=16 if args.dryrun else 32
+    )
+    seed = args.chaos_seed
+    params = get_model(cfg).init(domain_key(seed, "init"), cfg)
+    rng = np.random.default_rng(seed)
+    key = jax.random.key(seed)
+
+    configs = [
+        dict(temperature=0.8, top_k=20),
+        dict(temperature=1.0, top_p=0.9),
+        dict(),
+    ]
+    requests = []
+    for i in range(n_req):
+        tp = int(rng.integers(4, buckets.buckets[-1] + 1))
+        kw = dict(configs[i % len(configs)])
+        if kw.get("temperature"):
+            kw["key"] = jax.random.fold_in(key, i)
+        requests.append((
+            np.asarray(rng.integers(0, cfg.vocab_size, (tp,)), np.int32),
+            kw,
+        ))
+
+    def make_engine():
+        return BatchedDecodeEngine(
+            cfg, slots=slots, max_len=max_len, buckets=buckets,
+            dispatch_retries=None, request_retries=8,
+            retry_backoff_s=0.0,  # measured: don't sleep, just redo
+        )
+
+    # Calibrate one arrival process off a throwaway warm engine, shared
+    # verbatim by both legs (the chaos leg must face the same offered
+    # load it is being compared on).
+    probe = make_engine()
+    probe.warmup(params)
+    t0 = time.perf_counter()
+    probe.run(params, [dict(prompt=requests[0][0],
+                            max_new_tokens=max_new, **requests[0][1])])
+    per_req_est = time.perf_counter() - t0
+    mean_interarrival = per_req_est / max(2, slots // 2)
+    arrivals = np.concatenate(
+        [[0.0], np.cumsum(rng.exponential(mean_interarrival, n_req - 1))]
+    )
+
+    def drive(injector):
+        eng = make_engine()
+        if injector is not None:
+            injector.install(eng)
+        eng.warmup(params)
+        warm = eng.compile_count()
+        clock = 0.0
+        pending = list(zip(arrivals, range(n_req)))
+        submitted: dict[int, float] = {}
+        lat: dict[int, float] = {}
+        while pending or eng.has_work():
+            while pending and pending[0][0] <= clock:
+                arr, i = pending.pop(0)
+                prompt, ckw = requests[i]
+                rid = eng.submit(prompt, max_new, **ckw)
+                submitted[rid] = arr
+            if not eng.has_work():
+                clock = pending[0][0]
+                continue
+            t0 = time.perf_counter()
+            done = eng.step(params)
+            clock += time.perf_counter() - t0
+            for rid in done:
+                lat[rid] = clock - submitted[rid]
+        span = clock - arrivals[0]
+        results = {rid: eng.pop_result(rid) for rid in list(eng.results)}
+        steady = eng.compile_count() - warm
+        return span, lat, results, eng.stats, steady
+
+    def _pct(xs, q):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
+
+    def _leg(span, lat, results, stats, steady):
+        good_tokens = sum(
+            len(r.tokens) - len(requests[rid][0])
+            for rid, r in results.items() if r.state == DONE
+        )
+        lat = list(lat.values())
+        return {
+            "goodput_tokens_per_sec": round(good_tokens / span, 1),
+            "p50_request_ms": round(_pct(lat, 0.50) * 1e3, 2),
+            "p99_request_ms": round(_pct(lat, 0.99) * 1e3, 2),
+            "terminal_states": {
+                s: sum(1 for r in results.values() if r.state == s)
+                for s in sorted({r.state for r in results.values()})
+            },
+            "dispatch_failures": stats["dispatch_failures"],
+            "resumes": stats["resumes"],
+            "nan_quarantines": stats["nan_quarantines"],
+            "observed_compile_count_steady": steady,
+        }
+
+    clean = _leg(*drive(None))
+    p_fault = (0.10, 0.06, 0.12) if args.dryrun else (0.03, 0.02, 0.05)
+    injector = FaultInjector(
+        seed=seed + 1,
+        p_dispatch_error=p_fault[0],
+        p_drop_result=p_fault[1],
+        p_nan_row=p_fault[2],
+    )
+    chaos = _leg(*drive(injector))
+    for kind, count in injector.counts.items():
+        if kind != "slow_tick" and count == 0:
+            print(
+                f"warning: fault kind {kind!r} never fired this seed — "
+                "the chaos leg under-exercised recovery",
+                file=sys.stderr,
+            )
+
+    row = {
+        "leg": "serving_batched_chaos",
+        "model": dict(
+            n_embd=cfg.n_embd, n_layer=cfg.n_layer,
+            vocab_size=cfg.vocab_size,
+        ),
+        "slots": slots,
+        "max_new": max_new,
+        "max_len": max_len,
+        "requests": n_req,
+        "buckets": list(buckets.buckets),
+        "chaos_seed": seed,
+        "mean_interarrival_ms": round(mean_interarrival * 1e3, 2),
+        "fault_probabilities": {
+            "p_dispatch_error": p_fault[0],
+            "p_drop_result": p_fault[1],
+            "p_nan_row": p_fault[2],
+        },
+        "fault_counts": {
+            k: v for k, v in injector.counts.items() if k != "slow_tick"
+        },
+        "clean": clean,
+        "chaos": chaos,
+        "goodput_retention": round(
+            chaos["goodput_tokens_per_sec"]
+            / max(clean["goodput_tokens_per_sec"], 1e-9), 3,
+        ),
+        "platform": jax.devices()[0].platform,
+    }
+    return [row]
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default=None,
@@ -730,6 +916,15 @@ def main() -> int:
                          "(BatchedDecodeEngine) vs the serial engine on "
                          "a Poisson-ish mixed-length arrival stream "
                          "(benchmarks/serving_batched_bench.json)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="with --serving-batched: add the robustness leg "
+                         "— the same seeded arrival stream under a "
+                         "seeded fault schedule, reporting goodput and "
+                         "p50/p99 including retries "
+                         "(benchmarks/serving_chaos_bench.json)")
+    ap.add_argument("--chaos-seed", type=int, default=0,
+                    help="seed for the --chaos arrival stream AND fault "
+                         "schedule (deterministic artifact)")
     ap.add_argument("--dryrun", action="store_true",
                     help="with --serving/--serving-batched: tiny shapes "
                          "for the CI smoke")
@@ -739,12 +934,17 @@ def main() -> int:
     args = ap.parse_args()
     setup_platform(args)
 
+    if args.chaos and not args.serving_batched:
+        ap.error("--chaos requires --serving-batched")
     if args.serving or args.serving_batched:
         rows = []
         if args.serving:
             rows += bench_serving(args)
         if args.serving_batched:
-            rows += bench_serving_batched(args)
+            if args.chaos:
+                rows += bench_serving_chaos(args)
+            else:
+                rows += bench_serving_batched(args)
         for row in rows:
             print(json.dumps(row))
         if args.json:

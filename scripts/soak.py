@@ -1,0 +1,382 @@
+"""Randomized churn + fault soak for the batched serving engine.
+
+A serving robustness claim is a claim about INVARIANTS under composed
+faults, not about any single fault path — so this script drives a
+``BatchedDecodeEngine`` through a seeded storm of everything at once:
+mixed-length mixed-sampling arrivals, NaN-poisoned rows, dispatch
+failures, dropped results, scheduler stalls (which expire deadlines),
+mid-flight aborts, and (optionally) a full engine loss recovered through
+``snapshot``/``restore`` — then asserts the lifecycle invariants that
+docs/ROBUSTNESS.md promises:
+
+1. **No lost or duplicated request**: every submitted rid reaches
+   exactly ONE terminal ``RequestResult``; a terminal rid never
+   reappears in the queue or a slot (checked every tick).
+2. **Clean partial outputs**: every terminal output — DONE or not — is
+   a PREFIX of what a fault-free run of the same request schedule
+   produces; DONE outputs are BIT-IDENTICAL to it (fault recovery is
+   re-prefill + pre-folded PRNG, so surviving rows must not drift).
+3. **Zero steady-state recompiles**: after warmup, the whole storm
+   (admissions, retirements, quarantines, resumes, restores) adds no
+   compiled executables.
+4. **Bounded cache**: cache allocations == 1 (warmup) + one per
+   dispatch failure + one per engine rebuild — a fault storm must not
+   leak HBM.
+5. **The storm actually fired**: every injection kind counted > 0
+   (a soak that injected nothing is coverage theater).
+
+Determinism: ONE seed fixes the request schedule, the fault schedule
+(seeded Bernoulli per tick), the abort schedule, and the engine's
+``VirtualClock`` — a failure reproduces exactly from its seed, and the
+structured lifecycle log (``--log``) replays the whole incident.
+
+Usage:
+  python scripts/soak.py --requests 200 --seed 0          # full soak
+  python scripts/soak.py --dryrun                         # CI smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from _common import setup_platform  # noqa: F401  (sys.path side effect)
+
+
+def _build_requests(rng, cfg, n_req, max_len, *, key_seeds,
+                    deadline_range=(0.5, 4.0)):
+    """The seeded request schedule: prompts, budgets, sampling configs,
+    deadlines. Shared VERBATIM by the chaos and fault-free legs."""
+    import jax
+    import numpy as np
+
+    reqs = []
+    for i in range(n_req):
+        tp = int(rng.integers(3, 17))
+        max_new = int(rng.integers(1, 9))
+        kind = int(rng.integers(0, 3))
+        kw = {}
+        if kind == 1:
+            kw = dict(temperature=0.9, top_k=17,
+                      key=jax.random.key(key_seeds + i))
+        elif kind == 2:
+            kw = dict(temperature=1.1, top_p=0.9,
+                      key=jax.random.key(key_seeds + i))
+        # A third of the stream carries a deadline tight enough that the
+        # injected slow_tick stalls expire some of them (virtual time —
+        # the fault-free leg's clock never advances, so ITS deadlines
+        # never fire and the all-DONE reference stays intact).
+        if rng.random() < 0.33:
+            kw["timeout_s"] = float(rng.uniform(*deadline_range))
+        prompt = np.asarray(
+            rng.integers(0, cfg.vocab_size, (tp,)), np.int32
+        )
+        reqs.append(dict(prompt=prompt, max_new_tokens=max_new, **kw))
+    return reqs
+
+
+def _drive(engine, params, reqs, *, injector, abort_rng, p_abort,
+           loss_tick, make_engine, max_ticks, rng_draws):
+    """Drive one leg: submit arrivals per the schedule, step, apply
+    seeded aborts against LIVE rids, optionally kill + rebuild the
+    engine mid-stream. Returns (results, invariant_violations,
+    engines_used, submitted, ticks)."""
+    from pytorch_distributed_tpu.serving.lifecycle import TERMINAL_STATES
+
+    submitted = {}
+    next_req = 0
+    violations = []
+    engines = [engine]
+    seen_terminal: set[int] = set()
+    tick = 0
+    while (next_req < len(reqs) or engine.has_work()) and tick < max_ticks:
+        tick += 1
+        # Seeded arrival burst (0..arrivals_per_tick new requests).
+        n_new = min(rng_draws[tick % len(rng_draws)], len(reqs) - next_req)
+        for _ in range(n_new):
+            rid = engine.submit(**reqs[next_req])
+            submitted[rid] = next_req
+            next_req += 1
+        if not engine.has_work():
+            continue
+        engine.step(params)
+        # Seeded mid-flight aborts (chaos leg only): one Bernoulli per
+        # tick, target drawn among the LIVE rids — mid-decode rows
+        # preferred so the abort exercises slot retirement, not just
+        # queue removal. Drawing at fire time (not pre-scripting
+        # (tick, rid) pairs blind) keeps the schedule a pure function
+        # of the seed while guaranteeing aborts actually land.
+        if abort_rng is not None and abort_rng.random() < p_abort:
+            live = engine.active_rids() or engine.queued_rids()
+            if live:
+                engine.abort(int(live[abort_rng.integers(len(live))]))
+        # Invariant 1, checked EVERY tick: a terminal rid never
+        # reappears live; every result state is a valid terminal.
+        live = set(engine.queued_rids()) | set(engine.active_rids())
+        for rid, res in engine.results.items():
+            if res.state not in TERMINAL_STATES:
+                violations.append(f"tick {tick}: rid {rid} non-terminal "
+                                  f"state {res.state}")
+            seen_terminal.add(rid)
+        back = live & seen_terminal
+        if back:
+            violations.append(
+                f"tick {tick}: terminal rids re-entered the engine: "
+                f"{sorted(back)}"
+            )
+        # Simulated engine loss: snapshot the dying engine, rebuild from
+        # scratch (fresh programs, fresh cache), restore, keep going.
+        if loss_tick is not None and tick == loss_tick:
+            snap = engine.snapshot()
+            engine = make_engine()
+            engine.warmup(params)
+            engine._warm_count = engine.compile_count()
+            engine.restore(snap)
+            if injector is not None:
+                injector.install(engine)
+            engines.append(engine)
+    results = {}
+    for eng in engines:
+        results.update(eng.results)
+        eng.results.clear()
+    return results, violations, engines, submitted, tick
+
+
+def run_soak(args) -> dict:
+    import jax  # noqa: F401  (platform set by caller)
+    import numpy as np
+
+    from pytorch_distributed_tpu.config import ModelConfig
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.serving.chaos import (
+        FaultInjector,
+        VirtualClock,
+    )
+    from pytorch_distributed_tpu.serving.engine import (
+        BatchedDecodeEngine,
+        BucketSpec,
+    )
+    from pytorch_distributed_tpu.serving.lifecycle import DONE
+
+    cfg = ModelConfig(
+        family="gpt2", vocab_size=97, n_ctx=64, n_embd=64, n_layer=2,
+        n_head=4, dtype="float32", attn_pdrop=0.0, resid_pdrop=0.0,
+        embd_pdrop=0.0,
+    )
+    max_len = 32
+    slots = args.slots
+    buckets = BucketSpec((8, 16))
+    params = get_model(cfg).init(jax.random.key(args.seed), cfg)
+    rng = np.random.default_rng(args.seed)
+    reqs = _build_requests(
+        rng, cfg, args.requests, max_len, key_seeds=1000 + args.seed,
+        deadline_range=tuple(args.deadline_range),
+    )
+    # Seeded per-tick arrival burst sizes (a long cycle is plenty —
+    # the point is bursty, seed-reproducible churn).
+    rng_draws = [int(rng.integers(0, 3)) for _ in range(997)]
+
+    def make_engine(*, clock, sleep):
+        return BatchedDecodeEngine(
+            cfg, slots=slots, max_len=max_len, buckets=buckets,
+            request_retries=args.request_retries,
+            dispatch_retries=None,  # the soak never gives up; the
+            # max_ticks guard bounds a pathological schedule instead
+            retry_backoff_s=0.01,
+            clock=clock, sleep=sleep,
+        )
+
+    # -- fault-free reference leg (same schedule, no injector/aborts) ----
+    ref_clock = VirtualClock()
+    ref = make_engine(clock=ref_clock, sleep=ref_clock.sleep)
+    ref.warmup(params)
+    ref_warm = ref.compile_count()
+    ref_results, ref_viol, _, ref_submitted, _ = _drive(
+        ref, params, reqs, injector=None, abort_rng=None, p_abort=0.0,
+        loss_tick=None, make_engine=None, max_ticks=args.max_ticks,
+        rng_draws=rng_draws,
+    )
+    assert not ref_viol, ref_viol
+    assert all(r.state == DONE for r in ref_results.values()), (
+        "fault-free leg must finish everything DONE"
+    )
+    ref_steady = ref.compile_count() - ref_warm
+
+    # -- chaos leg -------------------------------------------------------
+    clock = VirtualClock()
+    injector = FaultInjector(
+        seed=args.seed + 1,
+        p_dispatch_error=args.p_dispatch_error,
+        p_drop_result=args.p_drop_result,
+        p_nan_row=args.p_nan_row,
+        p_slow_tick=args.p_slow_tick,
+        slow_tick_s=1.0,
+        clock=clock,
+    )
+    eng = make_engine(clock=clock, sleep=clock.sleep)
+    injector.install(eng)
+    eng.warmup(params)
+    warm = eng.compile_count()
+    eng._warm_count = warm
+    # Seeded abort schedule: a per-tick Bernoulli whose target is drawn
+    # among the rids live AT FIRE TIME (_drive) — a client cancelling a
+    # request it knows to be in flight, which is what abort() models.
+    abort_rng = np.random.default_rng(args.seed + 7)
+    loss_tick = args.engine_loss_tick if args.engine_loss_tick > 0 else None
+    results, violations, engines, submitted, ticks = _drive(
+        eng, params, reqs, injector=injector, abort_rng=abort_rng,
+        p_abort=args.p_abort, loss_tick=loss_tick,
+        make_engine=lambda: make_engine(clock=clock, sleep=clock.sleep),
+        max_ticks=args.max_ticks, rng_draws=rng_draws,
+    )
+
+    # -- invariants ------------------------------------------------------
+    failures = list(violations)
+    # 1. No lost or duplicated request.
+    if set(results) != set(submitted):
+        lost = sorted(set(submitted) - set(results))
+        extra = sorted(set(results) - set(submitted))
+        failures.append(f"lost rids {lost[:10]}, phantom rids {extra[:10]}")
+    # 2. DONE outputs bit-identical to the fault-free leg; every other
+    #    terminal output a clean prefix of it.
+    by_state: dict[str, int] = {}
+    for rid, res in results.items():
+        by_state[res.state] = by_state.get(res.state, 0) + 1
+        ref_tokens = np.asarray(ref_results[rid].tokens)
+        got = np.asarray(res.tokens)
+        if res.state == DONE:
+            if not np.array_equal(got, ref_tokens):
+                failures.append(
+                    f"rid {rid} DONE but tokens diverge from the "
+                    "fault-free run"
+                )
+        elif not np.array_equal(got, ref_tokens[: len(got)]):
+            failures.append(
+                f"rid {rid} {res.state} partial output is not a clean "
+                "prefix of the fault-free run"
+            )
+    # 3. Zero steady-state recompiles on every engine incarnation.
+    for i, e in enumerate(engines):
+        steady = e.compile_count() - getattr(e, "_warm_count", warm)
+        if steady != 0:
+            failures.append(f"engine {i}: {steady} steady-state compiles")
+    if ref_steady != 0:
+        failures.append(f"reference leg: {ref_steady} steady compiles")
+    # 4. Bounded cache: warmup alloc + one per dispatch failure + one per
+    #    rebuild (the donated buffer is consumed by the failed dispatch).
+    total_failures = sum(
+        e.stats["dispatch_failures"] for e in engines
+    )
+    total_allocs = sum(e.stats["cache_allocs"] for e in engines)
+    alloc_bound = len(engines) + total_failures
+    if total_allocs > alloc_bound:
+        failures.append(
+            f"cache allocs {total_allocs} exceed bound {alloc_bound} "
+            "(1/warmup + 1/dispatch failure + 1/rebuild)"
+        )
+    # 5. The storm actually fired — every injection kind, plus at least
+    #    one abort and one deadline expiry landed (all seeded, so this is
+    #    a deterministic property of the seed, not a flake).
+    for kind, count in injector.counts.items():
+        if count == 0:
+            failures.append(f"fault kind {kind!r} never fired — the soak "
+                            "did not exercise it (raise its probability)")
+    for state in ("ABORTED", "EXPIRED"):
+        if not by_state.get(state):
+            failures.append(
+                f"no request retired {state} — this seed's schedule did "
+                "not exercise that lifecycle edge"
+            )
+
+    report = {
+        "seed": args.seed,
+        "requests": args.requests,
+        "slots": slots,
+        "ticks": ticks,
+        "virtual_time_s": round(clock.now, 3),
+        "terminal_states": by_state,
+        "fault_counts": injector.counts,
+        "engine_stats": [dict(e.stats) for e in engines],
+        "engine_rebuilds": len(engines) - 1,
+        "steady_compiles": [
+            e.compile_count() - getattr(e, "_warm_count", warm)
+            for e in engines
+        ],
+        "invariant_failures": failures,
+        "ok": not failures,
+    }
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--max-ticks", type=int, default=5000,
+                    help="hard guard: a pathological schedule terminates "
+                         "with partial results instead of hanging CI")
+    ap.add_argument("--request-retries", type=int, default=6)
+    ap.add_argument("--p-dispatch-error", type=float, default=0.02)
+    ap.add_argument("--p-drop-result", type=float, default=0.02)
+    ap.add_argument("--p-nan-row", type=float, default=0.04)
+    ap.add_argument("--p-slow-tick", type=float, default=0.05)
+    ap.add_argument("--p-abort", type=float, default=0.06,
+                    help="per-tick probability of aborting one live "
+                         "request (seeded; mid-decode rows preferred)")
+    ap.add_argument("--deadline-range", type=float, nargs=2,
+                    default=(0.5, 4.0), metavar=("LO", "HI"),
+                    help="timeout_s draw for the ~1/3 of requests that "
+                         "carry deadlines (virtual-clock seconds)")
+    ap.add_argument("--engine-loss-tick", type=int, default=60,
+                    help="simulate full engine loss (snapshot -> rebuild "
+                         "-> restore) at this tick; 0 disables")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="small CI smoke (24 requests)")
+    ap.add_argument("--json", default=None, help="write the report here")
+    ap.add_argument("--log", default=None,
+                    help="tee DEBUG lifecycle events (utils/logging."
+                         "log_event) to this file")
+    ap.add_argument("--cpu-devices", type=int, default=0)
+    args = ap.parse_args()
+    setup_platform(args)
+    if args.dryrun:
+        # Fewer requests means fewer ticks, so the per-tick fault
+        # probabilities scale UP to keep every injection kind firing —
+        # the smoke must exercise the same paths as the full soak.
+        args.requests = min(args.requests, 24)
+        args.engine_loss_tick = min(args.engine_loss_tick, 20)
+        args.p_dispatch_error = max(args.p_dispatch_error, 0.08)
+        args.p_drop_result = max(args.p_drop_result, 0.08)
+        args.p_nan_row = max(args.p_nan_row, 0.15)
+        args.p_slow_tick = max(args.p_slow_tick, 0.25)
+        args.p_abort = max(args.p_abort, 0.2)
+        args.deadline_range = (0.3, 1.5)
+    if args.log:
+        from pytorch_distributed_tpu.utils.logging import get_logger
+
+        lg = get_logger("pdtpu.serving")
+        lg.setLevel(logging.DEBUG)
+        lg.addHandler(logging.FileHandler(args.log, mode="w"))
+
+    report = run_soak(args)
+    print(json.dumps(report, indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    if not report["ok"]:
+        print("SOAK FAILED", file=sys.stderr)
+        return 1
+    print(
+        f"soak ok: {args.requests} requests, {report['ticks']} ticks, "
+        f"states {report['terminal_states']}, faults "
+        f"{report['fault_counts']}", file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -128,16 +128,21 @@ def test_generate_top_k_restricts_support():
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
 
 
-def test_generate_zero_new_tokens_returns_prompt():
-    """max_new_tokens=0 must return the prompt unchanged, not crash on a
-    static out-of-bounds write (advisor finding, round 2)."""
+def test_generate_budget_guards_reject_loudly():
+    """max_new_tokens <= 0 and prompt+budget overflow past max_len are
+    rejected with diagnostics NAMING the limit at every generate entry —
+    the old 0-token early return silently hid budget-accounting bugs in
+    serving loops, and the overflow previously failed deep in dispatch
+    (or silently clamped)."""
     cfg = _cfg("gpt2")
     params = get_model(cfg).init(jax.random.key(0), cfg)
     prompt = jax.random.randint(jax.random.key(4), (2, 4), 0, cfg.vocab_size)
-    out = decode.generate(params, prompt, cfg, 0)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
-    with pytest.raises(ValueError, match=">= 0"):
-        decode.generate(params, prompt, cfg, -1)
+    for bad in (0, -1):
+        with pytest.raises(ValueError, match="max_new_tokens must be >= 1"):
+            decode.generate(params, prompt, cfg, bad)
+    for entry in (decode.generate, decode.generate_monolithic):
+        with pytest.raises(ValueError, match="exceeds max_len 16"):
+            entry(params, prompt, cfg, 13, max_len=16)
 
 
 def test_generate_top_p_one_keeps_full_support_and_tiny_p_is_greedy():

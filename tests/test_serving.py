@@ -304,15 +304,16 @@ def test_engine_request_validation():
     with pytest.raises(ValueError, match="exceeds max_len"):
         DecodeEngine(cfg, max_len=16, buckets=BucketSpec((8, 32)))
     eng = DecodeEngine(cfg, max_len=16, buckets=BucketSpec((8, 16)))
-    with pytest.raises(ValueError, match="exceeds the engine max_len"):
+    with pytest.raises(ValueError, match="exceeds max_len 16"):
         eng.generate(params, prompt, 12)
     with pytest.raises(ValueError, match="empty prompt"):
         eng.generate(params, np.zeros((1, 0), np.int32), 4)
     with pytest.raises(ValueError, match="PRNG key"):
         eng.generate(params, prompt, 4, temperature=0.5)
-    # max_new_tokens=0 returns the prompt unchanged, touching no program.
-    out = eng.generate(params, prompt, 0)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+    # max_new_tokens<=0 is rejected loudly (the old 0-token early return
+    # silently hid budget-accounting bugs in serving loops).
+    with pytest.raises(ValueError, match="max_new_tokens must be >= 1"):
+        eng.generate(params, prompt, 0)
     assert eng.compile_count() == 0
 
 

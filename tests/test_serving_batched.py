@@ -106,8 +106,9 @@ def test_busy_batch_rows_match_serial_engine():
     out = eng.run(params, reqs)
     assert set(out) == {0, 1, 2, 3}
     for rid, req in enumerate(reqs):
+        assert out[rid].state == "DONE"
         np.testing.assert_array_equal(
-            out[rid], _serial_ref(serial, params, req),
+            out[rid].tokens, _serial_ref(serial, params, req),
             err_msg=f"request {rid}",
         )
 
@@ -123,14 +124,14 @@ def test_row_output_independent_of_neighbours():
     req = dict(prompt=_prompt(5, 1), max_new_tokens=6, temperature=0.9,
                key=jax.random.key(7), top_k=11)
     alone = BatchedDecodeEngine(cfg, slots=3, max_len=24, buckets=buckets)
-    out_alone = alone.run(params, [req])[0]
+    out_alone = alone.run(params, [req])[0].tokens
     busy = BatchedDecodeEngine(cfg, slots=3, max_len=24, buckets=buckets)
     neighbours = [
         dict(prompt=_prompt(9, 8), max_new_tokens=8, temperature=1.2,
              key=jax.random.key(8), top_p=0.8),
         dict(prompt=_prompt(12, 9), max_new_tokens=8),
     ]
-    out_busy = busy.run(params, [req] + neighbours)[0]
+    out_busy = busy.run(params, [req] + neighbours)[0].tokens
     np.testing.assert_array_equal(out_busy, out_alone)
 
 
@@ -144,7 +145,10 @@ def test_churn_zero_new_compiles():
     spec = BucketSpec((8, 16))
     eng = BatchedDecodeEngine(cfg, slots=2, max_len=24, buckets=spec)
     n_warm = eng.warmup(params)
-    assert n_warm == len(spec.buckets) * len(eng._groups) + 1
+    # Warmup covers the user buckets PLUS the max_len fault-resume bucket
+    # (a recovery re-prefill must never compile mid-incident).
+    assert eng._prefill_buckets == (8, 16, 24)
+    assert n_warm == len(eng._prefill_buckets) * len(eng._groups) + 1
     for wave in range(3):  # admit/retire churn, varying mixes
         reqs = [
             dict(prompt=_prompt(4 + wave, 20 + wave), max_new_tokens=3),
@@ -196,8 +200,12 @@ def test_retirement_keeps_neighbours_decoding():
     long = dict(prompt=_prompt(9, 61), max_new_tokens=12, temperature=1.0,
                 key=jax.random.key(61), top_p=0.95)
     out = eng.run(params, [short, long])
-    np.testing.assert_array_equal(out[0], _serial_ref(serial, params, short))
-    np.testing.assert_array_equal(out[1], _serial_ref(serial, params, long))
+    np.testing.assert_array_equal(
+        out[0].tokens, _serial_ref(serial, params, short)
+    )
+    np.testing.assert_array_equal(
+        out[1].tokens, _serial_ref(serial, params, long)
+    )
 
 
 def test_eos_stops_row_early():
@@ -217,8 +225,8 @@ def test_eos_stops_row_early():
     rid = eng.submit(req["prompt"], 6, eos_id=eos)
     other = eng.submit(_prompt(9, 62), 6)
     eng.run(params)
-    np.testing.assert_array_equal(eng.results[rid], ref[:first_hit])
-    assert len(eng.results[other]) == 9 + 6  # neighbour unaffected
+    np.testing.assert_array_equal(eng.results[rid].tokens, ref[:first_hit])
+    assert len(eng.results[other].tokens) == 9 + 6  # neighbour unaffected
 
 
 def test_batched_engine_validation():
@@ -246,18 +254,24 @@ def test_batched_engine_validation():
     )
     with pytest.raises(ValueError, match="one sequence per request"):
         eng.submit(np.zeros((2, 4), np.int32), 4)
-    with pytest.raises(ValueError, match="exceeds the engine max_len"):
+    with pytest.raises(ValueError, match="exceeds max_len 16"):
         eng.submit(_prompt(10, 0), 8)
     with pytest.raises(ValueError, match="PRNG key"):
         eng.submit(_prompt(4, 0), 4, temperature=0.5)
     with pytest.raises(ValueError, match="empty prompt"):
         eng.submit(np.zeros((0,), np.int32), 4)
-    # max_new_tokens=0 completes immediately, touching no program.
-    rid = eng.submit(_prompt(4, 0), 0)
-    np.testing.assert_array_equal(eng.results[rid], _prompt(4, 0))
+    # max_new_tokens<=0 is rejected loudly (the old 0-token fast path
+    # silently returned the prompt, hiding budget-accounting bugs).
+    with pytest.raises(ValueError, match="max_new_tokens must be >= 1"):
+        eng.submit(_prompt(4, 0), 0)
+    with pytest.raises(ValueError, match="timeout_s must be > 0"):
+        eng.submit(_prompt(4, 0), 2, timeout_s=0.0)
     assert eng.compile_count() == 0 and not eng.has_work()
-    # pop_result delivers AND releases (long-lived engines must pop).
-    np.testing.assert_array_equal(eng.pop_result(rid), _prompt(4, 0))
+    # pop_result delivers AND releases the terminal RequestResult.
+    rid = eng.submit(_prompt(4, 0), 2)
+    eng.run(params)
+    res = eng.pop_result(rid)
+    assert res.state == "DONE" and len(res.tokens) == 4 + 2
     assert rid not in eng.results
     with pytest.raises(KeyError):
         eng.pop_result(rid)
@@ -311,41 +325,50 @@ def test_cache_pool_lru_bounded():
         DecodeEngine(cfg, max_len=16, pool_max_entries=0)
 
 
-def test_failed_dispatch_aborts_in_flight_but_not_queued():
+def test_failed_dispatch_resumes_in_flight_and_spares_queued():
     """A dispatch failure consumed the donated cache, so in-flight rows
-    (their K/V is gone) abort — but QUEUED requests survive, the cache
-    re-allocates, and post-failure outputs are bit-correct (the batched
-    twin of the serial engine's pool-drop test)."""
+    lose their K/V — but instead of aborting they convert to RESUME
+    entries (re-prefilled from tokens-so-far ahead of younger queued
+    traffic), the cache re-allocates, and EVERY request finishes
+    token-equal to an undisturbed run."""
+    from pytorch_distributed_tpu.serving.chaos import (
+        Fault, FaultInjector,
+    )
+
     cfg = _cfg()
     params = _params(cfg)
-    eng = BatchedDecodeEngine(
-        cfg, slots=1, max_len=24, buckets=BucketSpec((8,))
-    )
     p = _prompt(5, 1)
-    r0 = eng.submit(p, 8)
-    r1 = eng.submit(p, 4)  # no free slot -> waits in the queue
-    eng.step(params)
-    real = eng.program("decode_step")
-
-    def boom(*a, **k):
-        raise RuntimeError("injected dispatch failure")
-
-    eng._programs["decode_step"] = boom
-    with pytest.raises(RuntimeError, match="injected"):
-        eng.step(params)
-    assert r0 in eng.aborted and eng.active_rids() == []
-    assert eng.pop_result(r0) is None  # aborted: delivered as None
-    assert r0 not in eng.aborted  # ...and released
-    assert eng._cache is None  # dropped, not poisoned
-    assert eng.queued_rids() == [r1]
-    eng._programs["decode_step"] = real
-    out = eng.run(params)
+    reqs = [
+        dict(prompt=p, max_new_tokens=8, temperature=0.9,
+             key=jax.random.key(21), top_k=13),
+        dict(prompt=p, max_new_tokens=4),  # no free slot -> queued
+    ]
     fresh = BatchedDecodeEngine(
         cfg, slots=1, max_len=24, buckets=BucketSpec((8,))
     )
-    np.testing.assert_array_equal(
-        out[r1], fresh.run(params, [dict(prompt=p, max_new_tokens=4)])[0]
+    undisturbed = fresh.run(params, reqs)
+    eng = BatchedDecodeEngine(
+        cfg, slots=1, max_len=24, buckets=BucketSpec((8,))
     )
+    # Tick 1 admits r0; tick 3's decode dispatch fails mid-request.
+    FaultInjector([Fault(tick=3, kind="dispatch_error")]).install(eng)
+    r0 = eng.submit(**reqs[0])
+    r1 = eng.submit(**reqs[1])
+    eng.step(params)
+    eng.step(params)
+    assert eng.active_rids() == [r0]
+    eng.step(params)  # injected failure: recovered, not raised
+    assert eng.active_rids() == []
+    assert eng._cache is None  # dropped, not poisoned
+    assert eng.queued_rids() == [r0, r1]  # resume ahead of queued FIFO
+    assert eng.stats["dispatch_failures"] == 1
+    out = eng.run(params)
+    for rid in (r0, r1):
+        assert out[rid].state == "DONE"
+        np.testing.assert_array_equal(
+            out[rid].tokens, undisturbed[rid].tokens,
+            err_msg=f"request {rid} diverged across the fault resume",
+        )
 
 
 def test_batched_donation_aliases_every_program(audit):
@@ -397,7 +420,7 @@ def test_busy_batch_matrix(family, sampled):
     out = eng.run(params, reqs)
     for rid, req in enumerate(reqs):
         np.testing.assert_array_equal(
-            out[rid], _serial_ref(serial, params, req),
+            out[rid].tokens, _serial_ref(serial, params, req),
             err_msg=f"{family} sampled={sampled} request {rid}",
         )
 
@@ -430,7 +453,7 @@ def test_busy_batch_tp_matches_serial(eight_devices, family, sampled):
     out = eng.run(params, reqs)
     for rid, req in enumerate(reqs):
         np.testing.assert_array_equal(
-            out[rid], _serial_ref(serial, params, req),
+            out[rid].tokens, _serial_ref(serial, params, req),
             err_msg=f"tp {family} sampled={sampled} request {rid}",
         )
 
@@ -455,7 +478,7 @@ def test_gqa_slot_reuse_no_stale_kv():
     req = dict(prompt=_prompt(3, 91), max_new_tokens=6)
     out = eng.run(params, [req])
     np.testing.assert_array_equal(
-        out[1], _serial_ref(serial, params, req)
+        out[1].tokens, _serial_ref(serial, params, req)
     )
 
 
