@@ -89,6 +89,36 @@ def _build_baseline():
     return step, args, NO_COLLECTIVES, {"compute_dtype": cfg.dtype}
 
 
+def _build_train_guard():
+    """The baseline step with the traced anomaly guard compiled in
+    (train/guard.py): the guard's contract is that detection + the no-op
+    select add ZERO collectives — pinned here the way the serving NaN
+    sentinel is pinned on the decode programs."""
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.train.guard import (
+        GuardConfig,
+        init_guard_state,
+    )
+    from pytorch_distributed_tpu.train.optim import make_optimizer
+    from pytorch_distributed_tpu.train.state import init_train_state
+    from pytorch_distributed_tpu.train.trainer import make_train_step
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _tiny()
+    model = get_model(cfg)
+    tx = make_optimizer(_tcfg())
+    state = init_train_state(
+        model.init(domain_key(42, "init"), cfg), tx,
+        guard=init_guard_state(),
+    )
+    step = make_train_step(
+        model, cfg, tx,
+        guard=GuardConfig(vocab_size=cfg.vocab_size),
+    )
+    args = (state, _batch(), jax.random.key(0))
+    return step, args, NO_COLLECTIVES, {"compute_dtype": cfg.dtype}
+
+
 def _build_explicit(
     mcfg: MeshConfig,
     n_experts: int = 0,
@@ -283,6 +313,12 @@ def registered_cases() -> dict[str, AuditCase]:
             "single-device jit train step (no mesh, no collectives)",
             1,
             _build_baseline,
+        ),
+        AuditCase(
+            "train_guard",
+            "guarded train step: traced anomaly guard adds no collectives",
+            1,
+            _build_train_guard,
         ),
         AuditCase(
             "ddp",

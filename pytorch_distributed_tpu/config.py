@@ -304,6 +304,47 @@ class TrainConfig:
                 f"unknown accum_dtype: {self.accum_dtype!r} "
                 "(implemented: float32, bfloat16)"
             )
+        if self.anomaly_guard:
+            # Late guard failures would kill a run at its first anomaly;
+            # validate the policy here (GuardConfig re-validates the
+            # traced parameters).
+            from pytorch_distributed_tpu.train.guard import GuardConfig
+
+            GuardConfig(
+                spike_factor=self.guard_spike_factor,
+                ema_decay=self.guard_ema_decay,
+                warmup_steps=self.guard_warmup_steps,
+                rollback_after=self.guard_rollback_after,
+            )
+            if self.guard_max_rollbacks < 1:
+                raise ValueError(
+                    f"guard_max_rollbacks must be >= 1, got "
+                    f"{self.guard_max_rollbacks}"
+                )
+    # Traced anomaly guard (train/guard.py): a non-finite loss/grad
+    # sentinel + EMA loss-spike check + corrupt-token-id check computed
+    # INSIDE the compiled step. On anomaly the update is a traced no-op
+    # (params/opt_state carried unchanged) and counters ride
+    # TrainState.guard — zero host syncs per step, zero recompiles. The
+    # host reads the counters at the existing log-window sync; after
+    # guard_rollback_after CONSECUTIVE anomalies it rolls back to the
+    # last good checkpoint (see docs/ROBUSTNESS.md §9).
+    anomaly_guard: bool = False
+    guard_spike_factor: float = 3.0
+    guard_ema_decay: float = 0.98
+    guard_warmup_steps: int = 10
+    # Consecutive anomalies before the host rolls back (None: skip-only —
+    # anomalous updates are dropped but training never rewinds).
+    guard_rollback_after: int | None = 3
+    # Hard bound on rollbacks per train() call: a persistently anomalous
+    # run fails loudly instead of thrashing forever.
+    guard_max_rollbacks: int = 8
+    # On rollback, do NOT rewind the data stream: the window between the
+    # last checkpoint and the rollback is dropped (the policy for
+    # PERSISTENT data corruption — deterministic replay would hit the
+    # same bad batches again). Off (default): replay the window, the
+    # right call for transient faults (bit-identical recovery).
+    guard_skip_window: bool = False
     # Optional JSONL metrics sink: every logged window (step/loss/lr/
     # elapsed) is appended as one JSON object — machine-readable run
     # history beyond the reference's stdout prints (process 0 only under

@@ -50,6 +50,7 @@ def make_parallel_train_step(
     state: TrainState,
     *,
     accum_dtype: str = "float32",
+    guard=None,
 ):
     """Returns (train_step, batch_put) for a sharded TrainState.
 
@@ -79,10 +80,14 @@ def make_parallel_train_step(
         logits_sharding=logits_sharding,
         grad_shardings=grad_shardings,
         accum_dtype=accum_dtype,
+        guard=guard,
     )
     batch_sharding = NamedSharding(mesh, batch_spec)
     metrics_sharding = NamedSharding(mesh, jax.sharding.PartitionSpec())
 
+    metrics_shardings = {"loss": metrics_sharding, "grad_norm": metrics_sharding}
+    if guard is not None:
+        metrics_shardings["anomaly"] = metrics_sharding
     step = jax.jit(
         base_step,
         in_shardings=(
@@ -90,7 +95,7 @@ def make_parallel_train_step(
             {"inputs": batch_sharding, "targets": batch_sharding},
             None,
         ),
-        out_shardings=(shardings, {"loss": metrics_sharding, "grad_norm": metrics_sharding}),
+        out_shardings=(shardings, metrics_shardings),
         donate_argnums=(0,),
     )
 

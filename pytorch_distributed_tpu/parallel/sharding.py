@@ -244,6 +244,11 @@ def state_shardings(state: TrainState, mesh: Mesh, mesh_cfg: MeshConfig):
         params=jax.tree.map(to_sharding, p_specs),
         opt_state=jax.tree.map(to_sharding, o_specs),
         step=NamedSharding(mesh, P()),
+        # Guard counters (train/guard.GuardState) are a few replicated
+        # scalars; None (guard off) is an empty subtree and maps to None.
+        guard=jax.tree.map(
+            lambda _: NamedSharding(mesh, P()), state.guard
+        ),
     )
 
 

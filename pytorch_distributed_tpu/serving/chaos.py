@@ -10,6 +10,11 @@ sees it, so injection cannot change a compiled program, its shapes, or
 its pinned collective budgets (the whole point: the fault paths must
 exercise the SAME executables production runs).
 
+The schedule machinery (scripted + seeded arming, the ``VirtualClock``,
+firing counts) is the shared ``utils/chaos.ScriptedFaults`` core — the
+training-side injector (train/chaos.py) runs the identical engine with
+its own fault catalog and hook points.
+
 Injection points (the full catalog — docs/ROBUSTNESS.md):
 
 - ``dispatch_error`` — raise before the program runs. The donated cache
@@ -35,9 +40,13 @@ fired (a chaos test that injected nothing is coverage theater).
 
 from __future__ import annotations
 
-import dataclasses
-
 import numpy as np
+
+from pytorch_distributed_tpu.utils.chaos import (  # noqa: F401  (re-export)
+    ScriptedFaults,
+    VirtualClock,
+)
+from pytorch_distributed_tpu.utils import chaos as _chaos
 
 FAULT_KINDS = ("dispatch_error", "drop_result", "nan_row", "slow_tick")
 
@@ -52,46 +61,17 @@ class ChaosDroppedResult(RuntimeError):
     paid) but the output never reached the scheduler."""
 
 
-class VirtualClock:
-    """A deterministic engine clock: advances ONLY via ``sleep``/
-    ``advance`` (backoff sleeps and slow-tick faults). Pass as both
-    ``clock=`` and ``sleep=`` to the engine so deadlines, backoff, and
-    stalls replay identically run after run."""
-
-    def __init__(self, start: float = 0.0) -> None:
-        self.now = float(start)
-
-    def __call__(self) -> float:
-        return self.now
-
-    def sleep(self, seconds: float) -> None:
-        self.now += max(0.0, float(seconds))
-
-    advance = sleep
-
-
-@dataclasses.dataclass(frozen=True)
-class Fault:
-    """One scripted injection. ``tick`` is the engine's step counter
-    (first step = tick 1). ``program`` restricts dispatch faults to
-    'prefill' / 'decode_step' (None = first dispatch of the tick);
+class Fault(_chaos.Fault):
+    """One scripted serving injection. ``tick`` is the engine's step
+    counter (first step = tick 1). ``program`` restricts dispatch faults
+    to 'prefill' / 'decode_step' (None = first dispatch of the tick);
     ``row`` picks the nan_row target slot (None = seeded choice among
     active rows); ``seconds`` is the slow_tick stall."""
 
-    tick: int
-    kind: str
-    program: str | None = None
-    row: int | None = None
-    seconds: float | None = None  # None = injector's slow_tick_s
-
-    def __post_init__(self) -> None:
-        if self.kind not in FAULT_KINDS:
-            raise ValueError(
-                f"unknown fault kind {self.kind!r}; known: {FAULT_KINDS}"
-            )
+    KINDS = FAULT_KINDS
 
 
-class FaultInjector:
+class FaultInjector(ScriptedFaults):
     """Seeded + scripted fault schedule over an engine's dispatch hooks.
 
     ``faults``: scripted ``Fault`` list (fires exactly once each).
@@ -113,51 +93,27 @@ class FaultInjector:
         slow_tick_s: float = 0.25,
         clock: VirtualClock | None = None,
     ) -> None:
-        self._scripted: dict[int, list[Fault]] = {}
-        for f in faults:
-            self._scripted.setdefault(f.tick, []).append(f)
-        self._rng = (
-            np.random.default_rng(seed) if seed is not None else None
+        super().__init__(
+            faults,
+            seed=seed,
+            probabilities={
+                "dispatch_error": p_dispatch_error,
+                "drop_result": p_drop_result,
+                "nan_row": p_nan_row,
+                "slow_tick": p_slow_tick,
+            },
+            slow_kinds=("slow_tick",),
+            slow_s=slow_tick_s,
+            clock=clock,
+            fault_cls=Fault,
         )
-        self._p = {
-            "dispatch_error": p_dispatch_error,
-            "drop_result": p_drop_result,
-            "nan_row": p_nan_row,
-            "slow_tick": p_slow_tick,
-        }
-        self._slow_tick_s = float(slow_tick_s)
-        self._clock = clock
         self._engine = None
-        self._armed: list[Fault] = []  # this tick's not-yet-fired faults
-        self.counts = {k: 0 for k in FAULT_KINDS}
 
     def install(self, engine) -> "FaultInjector":
         engine.set_fault_injector(self)  # sets our _engine back-reference
         return self
 
     # -- engine hooks (host-side only) --------------------------------------
-
-    def on_tick(self, tick: int) -> None:
-        """Arm this tick's faults (scripted + seeded draws) and apply
-        slow_tick stalls immediately."""
-        self._armed = list(self._scripted.pop(tick, ()))
-        if self._rng is not None:
-            for kind, p in self._p.items():
-                if p > 0.0 and self._rng.random() < p:
-                    self._armed.append(
-                        Fault(tick, kind, seconds=self._slow_tick_s)
-                    )
-        for f in [f for f in self._armed if f.kind == "slow_tick"]:
-            self._armed.remove(f)
-            if self._clock is None:
-                raise ValueError(
-                    "slow_tick faults need the engine's VirtualClock "
-                    "passed as FaultInjector(clock=...)"
-                )
-            self._clock.advance(
-                self._slow_tick_s if f.seconds is None else f.seconds
-            )
-            self.counts["slow_tick"] += 1
 
     def before_dispatch(self, kind: str, tick: int) -> None:
         f = self._pop("dispatch_error", kind)
@@ -191,12 +147,3 @@ class FaultInjector:
                 bad[row] = True
                 self.counts["nan_row"] += 1
         return tok, bad
-
-    # -- internals -----------------------------------------------------------
-
-    def _pop(self, kind: str, program: str) -> Fault | None:
-        for f in self._armed:
-            if f.kind == kind and f.program in (None, program):
-                self._armed.remove(f)
-                return f
-        return None

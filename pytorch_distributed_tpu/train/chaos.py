@@ -1,0 +1,222 @@
+"""Deterministic fault injection for the training loop.
+
+The training twin of serving/chaos.py, built on the same shared schedule
+engine (utils/chaos.ScriptedFaults): seeded + scripted faults fired
+through HOST-SIDE hooks at the Trainer's step and save boundaries —
+nothing traced ever sees the injector, so injection cannot change the
+compiled train step, its shapes, or its pinned (absence-of-)collective
+budget. The fault paths exercise the SAME executable production training
+runs.
+
+Fault catalog (the full training fault model — docs/ROBUSTNESS.md §11):
+
+- ``crash``        — hard process death at a step boundary
+  (``crash_mode="exit"``: ``os._exit`` — no finally blocks, no signal
+  handlers, no async-save finalize, exactly like a kill -9 or a machine
+  loss) or an in-process ``ChaosCrash`` for tests (``"raise"``). With
+  ``program="save"`` the crash lands INSIDE a checkpoint save, the
+  instant before it becomes visible — the half-written-checkpoint
+  hazard the COMMIT marker exists for.
+- ``sigterm``      — SIGTERM to self mid-run: drives the preemption
+  path (save_on_preemption) end-to-end — finish the in-flight step,
+  checkpoint with loader position, exit.
+- ``bad_batch``    — corrupt the next step's host batch (token ids
+  forced outside [0, vocab), what a torn shard read actually looks
+  like) so the TRACED guard (train/guard.py) must detect and skip it.
+  Transient: a replayed window after rollback gets the clean batch.
+- ``ckpt_corrupt`` — flip one byte in the newest COMMITTED checkpoint's
+  payload (never its COMMIT marker — detection must come from the
+  checksum manifest, not from the marker's absence), forcing
+  ``resume_latest`` onto the next-older retained checkpoint.
+- ``slow_step``    — stall the host between steps (straggler /
+  interference model), measured by the supervisor's goodput leg.
+
+``scripts/train_supervisor.py`` storms all of these at once and proves
+recovery bit-exact against a fault-free run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from pathlib import Path
+
+import numpy as np
+
+from pytorch_distributed_tpu.train import checkpoint as ckpt_lib
+from pytorch_distributed_tpu.utils import chaos as _chaos
+from pytorch_distributed_tpu.utils.chaos import (  # noqa: F401  (re-export)
+    VirtualClock,
+)
+
+TRAIN_FAULT_KINDS = (
+    "crash", "sigterm", "bad_batch", "ckpt_corrupt", "slow_step"
+)
+
+# The exit status a crash fault dies with (distinct from python's 1 and
+# SIGTERM's 143 so the supervisor can attribute restarts).
+CRASH_EXIT_CODE = 43
+
+
+class ChaosCrash(BaseException):
+    """In-process form of an injected crash (``crash_mode="raise"``).
+    BaseException so library ``except Exception`` blocks can't swallow
+    the 'process died' simulation."""
+
+
+class TrainFault(_chaos.Fault):
+    """One scripted training injection. ``tick`` is the 1-based optimizer
+    step about to run. ``program`` restricts crash faults to "step"
+    (default, fires at the step boundary) or "save" (fires inside the
+    checkpoint save, pre-commit)."""
+
+    KINDS = TRAIN_FAULT_KINDS
+
+
+class TrainFaultInjector(_chaos.ScriptedFaults):
+    """Seeded + scripted fault schedule over the Trainer's host hooks.
+
+    ``crash_mode``: "raise" (ChaosCrash — catchable, for in-process
+    tests) or "exit" (``os._exit(CRASH_EXIT_CODE)`` — the real thing,
+    for the supervisor). ``counts_path``: when set, ``counts`` is
+    rewritten there after every firing — a crash fault cannot fire
+    without first recording itself, so the supervisor can aggregate
+    fault coverage across dead attempts. ``sleep``: how slow_step
+    stalls apply (wall ``time.sleep`` by default; pass a VirtualClock's
+    ``advance`` for deterministic tests).
+    """
+
+    def __init__(
+        self,
+        faults: tuple[TrainFault, ...] | list[TrainFault] = (),
+        *,
+        seed: int | None = None,
+        p_crash: float = 0.0,
+        p_sigterm: float = 0.0,
+        p_bad_batch: float = 0.0,
+        p_ckpt_corrupt: float = 0.0,
+        p_slow_step: float = 0.0,
+        slow_step_s: float = 0.05,
+        crash_mode: str = "raise",
+        bad_token: int = -1,
+        counts_path: str | Path | None = None,
+        sleep=None,
+    ) -> None:
+        if crash_mode not in ("raise", "exit"):
+            raise ValueError(
+                f"unknown crash_mode {crash_mode!r} "
+                "(implemented: raise, exit)"
+            )
+        super().__init__(
+            faults,
+            seed=seed,
+            probabilities={
+                "crash": p_crash,
+                "sigterm": p_sigterm,
+                "bad_batch": p_bad_batch,
+                "ckpt_corrupt": p_ckpt_corrupt,
+                "slow_step": p_slow_step,
+            },
+            slow_kinds=("slow_step",),
+            slow_s=slow_step_s,
+            advance=sleep if sleep is not None else time.sleep,
+            fault_cls=TrainFault,
+        )
+        self._crash_mode = crash_mode
+        self._bad_token = int(bad_token)
+        self._counts_path = Path(counts_path) if counts_path else None
+        self._corrupt_rng = np.random.default_rng(
+            seed if seed is not None else 0
+        )
+
+    def install(self, trainer) -> "TrainFaultInjector":
+        """Wire into a Trainer: step/save-boundary hooks plus the
+        checkpoint module's save hook (mid-save crashes)."""
+        trainer.set_fault_injector(self)
+        ckpt_lib.set_save_hook(self.on_save)
+        return self
+
+    # -- trainer hooks (host-side only) -------------------------------------
+
+    def on_step(self, step: int) -> None:
+        """Arm this step's faults; slow_step stalls apply immediately."""
+        self.on_tick(step)
+
+    def before_step(self, step: int, batch: dict) -> dict:
+        """Fire step-boundary faults; returns the (possibly poisoned)
+        batch the step will actually train on."""
+        if self._pop("crash", "step") is not None:
+            self._count("crash")
+            self._crash(f"injected crash at step {step}")
+        if self._pop("sigterm", "step") is not None:
+            self._count("sigterm")
+            os.kill(os.getpid(), signal.SIGTERM)
+        f = self._pop("bad_batch", "step")
+        if f is not None:
+            self._count("bad_batch")
+            batch = {k: np.array(v, copy=True) for k, v in batch.items()}
+            # Corrupt a slice of the first micro-batch's ids — exactly
+            # what a torn shard read yields. The traced guard's
+            # range check must catch it; nothing host-side tells the
+            # step this batch is special.
+            flat = batch["inputs"].reshape(-1)
+            n = max(1, flat.size // 8)
+            flat[:n] = self._bad_token
+        return batch
+
+    def on_save(self, stage: str, directory) -> None:
+        """Checkpoint-module hook: a ``program="save"`` crash fires the
+        instant before the save becomes visible."""
+        if stage == "pre_commit" and self._pop("crash", "save") is not None:
+            self._count("crash")
+            self._crash(f"injected crash mid-save of {directory}")
+
+    def after_save(self, checkpoint_root) -> None:
+        """Post-save hook: ckpt_corrupt flips one byte in the newest
+        COMMITTED checkpoint's payload."""
+        if self._pop("ckpt_corrupt", "step") is None:
+            return
+        latest = ckpt_lib.latest_checkpoint(checkpoint_root)
+        if latest is None:
+            return
+        target = self._corrupt_target(Path(latest))
+        if target is None:
+            return
+        data = bytearray(target.read_bytes())
+        if not data:
+            return
+        pos = int(self._corrupt_rng.integers(len(data)))
+        data[pos] ^= 0xFF
+        target.write_bytes(bytes(data))
+        self._count("ckpt_corrupt")
+
+    # -- internals -----------------------------------------------------------
+
+    def _corrupt_target(self, ckpt: Path) -> Path | None:
+        """Pick a payload file (npz arrays or an orbax tree file) — never
+        the COMMIT marker or manifest: detection must come from the
+        checksums, the way real bit rot presents."""
+        npz = ckpt / "arrays.npz"
+        if npz.is_file():
+            return npz
+        tree = ckpt / "tree"
+        if tree.is_dir():
+            files = sorted(p for p in tree.rglob("*") if p.is_file())
+            if files:
+                return files[int(self._corrupt_rng.integers(len(files)))]
+        return None
+
+    def _count(self, kind: str) -> None:
+        # Overrides the shared hook so EVERY firing — including the base
+        # engine's slow_step stalls — is persisted before anything else
+        # happens; a crash fault cannot erase the record.
+        super()._count(kind)
+        if self._counts_path is not None:
+            self._counts_path.write_text(json.dumps(self.counts))
+
+    def _crash(self, message: str):
+        if self._crash_mode == "exit":
+            os._exit(CRASH_EXIT_CODE)
+        raise ChaosCrash(message)

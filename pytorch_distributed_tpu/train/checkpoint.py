@@ -11,14 +11,27 @@ what the state needs; ``format=`` overrides):
 - ``npz``: one ``.npz`` with flattened leaves keyed by their tree path plus a
   ``meta.json`` sidecar. Self-contained numpy — readable without JAX — and
   path-keyed, so checkpoints survive refactors that reorder (but not rename)
-  the tree. Save is atomic (write temp dir, rename). SINGLE-HOST ONLY: it
-  device_gets every leaf, which throws on a pod where sharded leaves are not
-  fully addressable from one process.
+  the tree. SINGLE-HOST ONLY: it device_gets every leaf, which throws on a
+  pod where sharded leaves are not fully addressable from one process.
 - ``orbax``: tensorstore/OCDBT via orbax — every process writes exactly its
   addressable shards and restore places shards directly onto the target
   shardings (the idiomatic multi-host path, SURVEY.md §5.4; the reference's
   rank-0 torch.save, distributed_trainer.py:214-221, is naive here). Used
   automatically when any leaf is not fully addressable.
+
+**Integrity contract** (every format): a save writes a checksum
+``manifest.json`` (per-LEAF crc32 for npz, per-FILE crc32 for orbax) and
+an atomic ``COMMIT`` marker, all inside a temp dir that is renamed into
+place in one atomic step (the old checkpoint is parked in a ``.trash_``
+sibling during the swap, so no crash window destroys both generations).
+``latest_checkpoint``/``list_checkpoints`` only ever return COMMITTED
+directories — a crash mid-save can no longer produce a directory resume
+will pick — and ``load_checkpoint`` verifies the manifest first, raising
+``CheckpointCorrupt`` on any mismatch (bit rot, torn writes, truncation).
+``Trainer.resume_latest`` catches it and falls back to the next-older
+retained checkpoint. Crash-anywhere behavior is regression-tested by
+killing saves mid-write (tests/test_train_chaos.py) and stormed by
+scripts/train_supervisor.py.
 
 ``load_checkpoint`` restores into the structure AND shardings of the template
 pytree: leaves come back as jax.Arrays placed like the template's (the
@@ -32,11 +45,38 @@ import json
 import os
 import shutil
 import tempfile
+import zlib
 from pathlib import Path
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import numpy as np
+
+COMMIT_NAME = "COMMIT"
+MANIFEST_NAME = "manifest.json"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """The checkpoint directory fails its integrity contract: missing
+    COMMIT marker, missing/unreadable payload, or a checksum mismatch
+    against its manifest."""
+
+
+# Host-side fault hook for crash testing: called with (stage, directory)
+# at the instant before a save becomes visible (``pre_commit``). The
+# training fault injector (train/chaos.py) uses it to kill saves
+# mid-write; None in production.
+_SAVE_HOOK: Callable[[str, Path], None] | None = None
+
+
+def set_save_hook(hook: Callable[[str, Path], None] | None) -> None:
+    global _SAVE_HOOK
+    _SAVE_HOOK = hook
+
+
+def _fire_save_hook(stage: str, directory: Path) -> None:
+    if _SAVE_HOOK is not None:
+        _SAVE_HOOK(stage, directory)
 
 
 def _path_str(path) -> str:
@@ -61,6 +101,74 @@ def _fully_addressable(state: Any) -> bool:
         ):
             return False
     return True
+
+
+def _crc32(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _file_crc(path: Path) -> int:
+    """Streaming crc32 — multi-GB tensorstore files must not be held
+    wholly in RAM just to checksum them."""
+    crc = 0
+    with path.open("rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(chunk, crc)
+
+
+def _file_crcs(root: Path) -> dict[str, int]:
+    """crc32 of every regular file under ``root``, keyed by POSIX
+    relative path (the orbax/tensorstore payload manifest)."""
+    out: dict[str, int] = {}
+    for p in sorted(root.rglob("*")):
+        if p.is_file():
+            out[p.relative_to(root).as_posix()] = _file_crc(p)
+    return out
+
+
+def _commit_orbax(tmp: Path, directory: Path, metadata: dict) -> None:
+    """The shared orbax publish tail (sync save AND async finalize):
+    meta.json, checksum manifest, COMMIT marker, atomic swap. One
+    implementation so the on-disk integrity format cannot fork."""
+    meta_text = json.dumps(
+        {"format": "pdtpu-ckpt-orbax-v1", "metadata": metadata},
+        indent=1,
+    )
+    (tmp / "meta.json").write_text(meta_text)
+    (tmp / MANIFEST_NAME).write_text(
+        json.dumps(
+            {
+                "format": "pdtpu-ckpt-manifest-v1",
+                "meta_crc32": _crc32(meta_text.encode()),
+                "files": _file_crcs(tmp / "tree"),
+            },
+            indent=1,
+        )
+    )
+    _write_commit(tmp)
+    _swap_into_place(tmp, directory)
+
+
+def _write_commit(tmp: Path) -> None:
+    (tmp / COMMIT_NAME).write_text('{"format": "pdtpu-ckpt-commit-v1"}\n')
+
+
+def _swap_into_place(tmp: Path, directory: Path) -> None:
+    """Atomically publish ``tmp`` as ``directory``. The previous
+    generation is parked in a ``.trash_`` sibling for the swap (a crash
+    between the two renames leaves the OLD data recoverable there and no
+    half directory at the final name) and removed after."""
+    trash = directory.parent / (".trash_" + directory.name)
+    if trash.exists():
+        shutil.rmtree(trash)
+    _fire_save_hook("pre_commit", directory)
+    if directory.exists():
+        os.replace(directory, trash)
+    os.replace(tmp, directory)
+    shutil.rmtree(trash, ignore_errors=True)
 
 
 def save_checkpoint(
@@ -101,15 +209,32 @@ def save_checkpoint(
     tmp = Path(tempfile.mkdtemp(dir=directory.parent, prefix=".ckpt_tmp_"))
     try:
         np.savez(tmp / "arrays.npz", **arrays)
-        meta = {
-            "format": "pdtpu-ckpt-v1",
-            "keys": sorted(arrays.keys()),
-            "metadata": metadata or {},
+        meta_text = json.dumps(
+            {
+                "format": "pdtpu-ckpt-v1",
+                "keys": sorted(arrays.keys()),
+                "metadata": metadata or {},
+            },
+            indent=1,
+        )
+        (tmp / "meta.json").write_text(meta_text)
+        manifest = {
+            "format": "pdtpu-ckpt-manifest-v1",
+            # meta.json carries the loader position — rot there would
+            # silently resume on wrong data, so it is covered too.
+            "meta_crc32": _crc32(meta_text.encode()),
+            "leaves": {
+                k: {
+                    "crc32": _crc32(np.ascontiguousarray(a).tobytes()),
+                    "shape": list(a.shape),
+                    "dtype": str(a.dtype),
+                }
+                for k, a in arrays.items()
+            },
         }
-        (tmp / "meta.json").write_text(json.dumps(meta, indent=1))
-        if directory.exists():
-            shutil.rmtree(directory)
-        os.replace(tmp, directory)
+        (tmp / MANIFEST_NAME).write_text(json.dumps(manifest, indent=1))
+        _write_commit(tmp)
+        _swap_into_place(tmp, directory)
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
         raise
@@ -131,9 +256,16 @@ def _sync(tag: str) -> None:
 _PENDING_ASYNC: dict | None = None
 
 
+def pending_async_directory() -> Path | None:
+    """Target directory of the in-flight async save, if any — exposed so
+    ``prune_checkpoints`` can never race the save it belongs to."""
+    return None if _PENDING_ASYNC is None else _PENDING_ASYNC["directory"]
+
+
 def finalize_async_save() -> str | None:
     """Block until the in-flight async save (if any) commits, then perform
-    the tmp -> final swap + metadata write. Returns the finalized path.
+    the tmp -> final swap + manifest/metadata/COMMIT write. Returns the
+    finalized path.
 
     MUST run before: starting another save, reading latest_checkpoint, or
     process exit — Trainer calls it at those points automatically.
@@ -147,18 +279,10 @@ def finalize_async_save() -> str | None:
     directory: Path = pend["directory"]
     tmp: Path = pend["tmp"]
     if jax.process_index() == 0:
-        (tmp / "meta.json").write_text(
-            json.dumps(
-                {
-                    "format": "pdtpu-ckpt-orbax-v1",
-                    "metadata": pend["metadata"],
-                },
-                indent=1,
-            )
-        )
-        if directory.exists():
-            shutil.rmtree(directory)
-        os.replace(tmp, directory)
+        # The checksums are computed over the files orbax just finished
+        # writing — host-side reads at the (already blocking) finalize
+        # point, so the async overlap with training is untouched.
+        _commit_orbax(tmp, directory, pend["metadata"])
     if jax.process_count() > 1:
         _sync("pdtpu:ckpt:async-final")
     return str(directory)
@@ -169,10 +293,11 @@ def save_checkpoint_async(
 ) -> str:
     """Start an orbax save that overlaps training: device arrays are
     snapshotted now, the serialization/write runs in background threads,
-    and the checkpoint becomes VISIBLE (tmp -> final swap, meta.json) only
-    at the next ``finalize_async_save()`` — which this function calls
-    first for any previous in-flight save, so at most one save is ever
-    pending and callers can fire-and-forget on a cadence.
+    and the checkpoint becomes VISIBLE (tmp -> final swap, manifest +
+    COMMIT + meta.json) only at the next ``finalize_async_save()`` —
+    which this function calls first for any previous in-flight save, so
+    at most one save is ever pending and callers can fire-and-forget on
+    a cadence.
 
     Collective like the sync orbax path: EVERY process must call it.
     """
@@ -208,24 +333,16 @@ def _save_orbax(
     # Write into a deterministic sibling temp dir (same name on every
     # process), then swap. Orbax's collective save is itself atomic into the
     # temp location and returns only once all processes have committed, so
-    # the previous checkpoint is deleted only AFTER the new one is complete
-    # — a crash in the swap window leaves the new data recoverable at the
-    # temp path rather than destroying both.
+    # the previous checkpoint is parked/deleted only AFTER the new one is
+    # complete — a crash in the swap window leaves the new data recoverable
+    # at the temp path rather than destroying both.
     tmp = directory.parent / (".tmp_" + directory.name)
     if jax.process_index() == 0 and tmp.exists():
         shutil.rmtree(tmp)
     with ocp.PyTreeCheckpointer() as ckptr:
         ckptr.save(tmp / "tree", state)
     if jax.process_index() == 0:
-        (tmp / "meta.json").write_text(
-            json.dumps(
-                {"format": "pdtpu-ckpt-orbax-v1", "metadata": metadata or {}},
-                indent=1,
-            )
-        )
-        if directory.exists():
-            shutil.rmtree(directory)
-        os.replace(tmp, directory)
+        _commit_orbax(tmp, directory, metadata or {})
     if jax.process_count() > 1:
         # All processes wait for the swap: no one may act on the returned
         # path (or start a next save reusing tmp) while the rename is in
@@ -234,22 +351,128 @@ def _save_orbax(
     return str(directory)
 
 
-def load_checkpoint(directory: str | Path, like: Any) -> Any:
+def is_committed(directory: str | Path) -> bool:
+    return (Path(directory) / COMMIT_NAME).is_file()
+
+
+def _load_manifest(directory: Path) -> dict:
+    """COMMIT + manifest + meta.json checks (the cheap, non-payload part
+    of verification); returns the parsed manifest."""
+    if not is_committed(directory):
+        raise CheckpointCorrupt(
+            f"checkpoint {directory} has no {COMMIT_NAME} marker "
+            "(half-written save or pre-integrity format)"
+        )
+    try:
+        manifest = json.loads((directory / MANIFEST_NAME).read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorrupt(
+            f"checkpoint {directory}: unreadable {MANIFEST_NAME}: {e}"
+        ) from e
+    want_meta = manifest.get("meta_crc32")
+    if want_meta is not None:
+        try:
+            meta_bytes = (directory / "meta.json").read_bytes()
+        except OSError as e:
+            raise CheckpointCorrupt(
+                f"checkpoint {directory}: unreadable meta.json: {e}"
+            ) from e
+        got = _crc32(meta_bytes)
+        if got != want_meta:
+            raise CheckpointCorrupt(
+                f"checkpoint {directory}: meta.json checksum mismatch "
+                f"(manifest {want_meta}, file {got}) — the loader "
+                "position would be untrustworthy"
+            )
+    return manifest
+
+
+def _load_npz_arrays(directory: Path, *, wrap_errors: bool) -> dict:
+    try:
+        with np.load(directory / "arrays.npz") as data:
+            return {k: data[k] for k in data.files}
+    except Exception as e:  # zip/format damage surfaces many ways
+        if not wrap_errors:
+            raise
+        raise CheckpointCorrupt(
+            f"checkpoint {directory}: unreadable arrays.npz: {e}"
+        ) from e
+
+
+def _verify_npz_leaves(directory: Path, manifest: dict, arrays: dict) -> None:
+    for key, want in manifest["leaves"].items():
+        if key not in arrays:
+            raise CheckpointCorrupt(
+                f"checkpoint {directory}: leaf {key!r} missing from "
+                "arrays.npz"
+            )
+        got = _crc32(np.ascontiguousarray(arrays[key]).tobytes())
+        if got != want["crc32"]:
+            raise CheckpointCorrupt(
+                f"checkpoint {directory}: leaf {key!r} checksum "
+                f"mismatch (manifest {want['crc32']}, file {got})"
+            )
+
+
+def verify_checkpoint(directory: str | Path) -> None:
+    """Integrity check without a full restore: COMMIT present, manifest
+    present, meta.json and every payload checksum matching. Raises
+    ``CheckpointCorrupt`` naming the first offending leaf/file."""
+    directory = Path(directory)
+    manifest = _load_manifest(directory)
+    if "leaves" in manifest:
+        arrays = _load_npz_arrays(directory, wrap_errors=True)
+        _verify_npz_leaves(directory, manifest, arrays)
+    else:
+        for rel, want in manifest.get("files", {}).items():
+            f = directory / "tree" / rel
+            if not f.is_file():
+                raise CheckpointCorrupt(
+                    f"checkpoint {directory}: payload file {rel!r} missing"
+                )
+            got = _file_crc(f)
+            if got != want:
+                raise CheckpointCorrupt(
+                    f"checkpoint {directory}: payload file {rel!r} "
+                    f"checksum mismatch (manifest {want}, file {got})"
+                )
+
+
+def load_checkpoint(
+    directory: str | Path, like: Any, *, verify: bool = True
+) -> Any:
     """Restore into the structure AND shardings of ``like`` (a template
     pytree, e.g. a freshly initialised — possibly sharded — TrainState; the
     analogue of load_state_dict restoring into constructed modules,
     reference trainer.py:130-141, with map_location generalised to
-    shardings)."""
+    shardings). ``verify`` (default) checks the integrity manifest first
+    and raises ``CheckpointCorrupt`` on damage (the npz payload is read
+    ONCE — checksums are taken on the same arrays the restore uses); pass
+    False only for forensics on a checkpoint you know is damaged."""
     directory = Path(directory)
     if (directory / "tree").exists():
+        if verify:
+            verify_checkpoint(directory)
         return _load_orbax(directory, like)
-    with np.load(directory / "arrays.npz") as data:
-        arrays = {k: data[k] for k in data.files}
+    if verify:
+        manifest = _load_manifest(directory)
+        arrays = _load_npz_arrays(directory, wrap_errors=True)
+        if "leaves" in manifest:
+            _verify_npz_leaves(directory, manifest, arrays)
+    else:
+        arrays = _load_npz_arrays(directory, wrap_errors=False)
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(like)
     new_leaves = []
     for path, leaf in leaves_with_paths:
         key = _path_str(path)
         if key not in arrays:
+            if key.split("/", 1)[0] == "guard":
+                # Pre-guard checkpoint restored into a guard-enabled
+                # template (a run upgraded to anomaly_guard mid-life):
+                # the counters start fresh — the template's
+                # init_guard_state values ARE the right defaults.
+                new_leaves.append(leaf)
+                continue
             raise KeyError(
                 f"checkpoint {directory} missing leaf {key!r}; "
                 f"has {len(arrays)} leaves"
@@ -262,8 +485,20 @@ def load_checkpoint(directory: str | Path, like: Any) -> Any:
             )
         restored = got.astype(leaf.dtype)
         if isinstance(leaf, jax.Array) and hasattr(leaf, "sharding"):
-            # Re-apply the template's placement (sharded restore).
-            restored = jax.device_put(restored, leaf.sharding)
+            if getattr(leaf, "_committed", True):
+                # Re-apply the template's placement (sharded restore).
+                restored = jax.device_put(restored, leaf.sharding)
+            else:
+                # The template is UNCOMMITTED (a plain jit output, the
+                # single-device trainer's normal state). device_put would
+                # pin the restored leaves and make the next train_step
+                # compile a second committed-inputs executable — a
+                # restored run must hit the SAME cache entry the
+                # uninterrupted run compiled (the zero-steady-state-
+                # recompile contract, pinned in tests/test_train_chaos).
+                import jax.numpy as jnp
+
+                restored = jnp.asarray(restored)
         new_leaves.append(restored)
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
@@ -296,13 +531,56 @@ def read_metadata(directory: str | Path) -> dict:
     return meta.get("metadata", {})
 
 
+def _step_dirs(root: Path) -> list[tuple[int, Path]]:
+    steps: list[tuple[int, Path]] = []
+    for child in root.iterdir():
+        if child.is_dir() and child.name.startswith("checkpoint_step_"):
+            try:
+                steps.append((int(child.name.rsplit("_", 1)[1]), child))
+            except ValueError:
+                continue
+    return steps
+
+
+def _step_dirs_by_commit(
+    checkpoint_root: str | Path, *, committed: bool
+) -> list[str]:
+    root = Path(checkpoint_root)
+    if not root.exists():
+        return []
+    steps = [
+        (s, p) for s, p in _step_dirs(root) if is_committed(p) == committed
+    ]
+    steps.sort(reverse=True)
+    return [str(p) for _, p in steps]
+
+
+def list_checkpoints(checkpoint_root: str | Path) -> list[str]:
+    """COMMITTED ``checkpoint_step_{n}`` dirs, newest first — the
+    fallback order ``Trainer.resume_latest`` walks when the newest one
+    fails verification."""
+    return _step_dirs_by_commit(checkpoint_root, committed=True)
+
+
+def uncommitted_checkpoints(checkpoint_root: str | Path) -> list[str]:
+    """``checkpoint_step_{n}`` dirs WITHOUT a COMMIT marker — half-written
+    saves, or checkpoints from the pre-integrity format. Never resumable;
+    surfaced so ``Trainer.resume_latest`` can warn loudly instead of
+    silently restarting from scratch next to them."""
+    return _step_dirs_by_commit(checkpoint_root, committed=False)
+
+
 def prune_checkpoints(checkpoint_root: str | Path, keep: int) -> list[str]:
-    """Delete all but the newest ``keep`` ``checkpoint_step_{n}`` dirs.
+    """Delete all but the newest ``keep`` ``checkpoint_step_{n}`` dirs
+    (and sweep post-swap ``.trash_`` leftovers plus save temp dirs
+    orphaned by a hard crash mid-save).
 
     Process-0 only (other processes no-op); call AFTER a successful save —
     the collective save's own barrier guarantees no peer is still writing
     the surviving checkpoints, and deleted ones are strictly older than
-    the one just committed. Returns the removed paths.
+    the one just committed. Never touches the target of an in-flight
+    async save (``pending_async_directory``) or any ``.tmp_`` dir it is
+    writing. Returns the removed paths.
     """
     if keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep}")
@@ -311,13 +589,29 @@ def prune_checkpoints(checkpoint_root: str | Path, keep: int) -> list[str]:
     root = Path(checkpoint_root)
     if not root.exists():
         return []
-    steps: list[tuple[int, Path]] = []
+    pending = pending_async_directory()
+    pending_tmp = (
+        None if pending is None else ".tmp_" + pending.name
+    )
     for child in root.iterdir():
-        if child.is_dir() and child.name.startswith("checkpoint_step_"):
-            try:
-                steps.append((int(child.name.rsplit("_", 1)[1]), child))
-            except ValueError:
-                continue
+        if not child.is_dir():
+            continue
+        # Post-swap .trash_ parking dirs are garbage the moment the swap
+        # is done. Orphaned save temp dirs (a hard crash mid-save skips
+        # the in-process cleanup) are garbage too and checkpoint-sized —
+        # without this sweep a crash storm grows disk unboundedly. The
+        # npz .ckpt_tmp_ dirs are written synchronously by THIS process,
+        # so by prune time (always after a completed save) none is live;
+        # of the async .tmp_ dirs only the pending save's target is.
+        if child.name.startswith((".trash_", ".ckpt_tmp_")) or (
+            child.name.startswith(".tmp_") and child.name != pending_tmp
+        ):
+            shutil.rmtree(child, ignore_errors=True)
+    steps = [
+        (s, p)
+        for s, p in _step_dirs(root)
+        if pending is None or p.resolve() != pending
+    ]
     steps.sort(reverse=True)
     removed = []
     for _, path in steps[keep:]:
@@ -327,18 +621,8 @@ def prune_checkpoints(checkpoint_root: str | Path, keep: int) -> list[str]:
 
 
 def latest_checkpoint(checkpoint_root: str | Path) -> str | None:
-    """Find the newest ``checkpoint_step_{n}`` dir (reference naming
-    trainer.py:100-106)."""
-    root = Path(checkpoint_root)
-    if not root.exists():
-        return None
-    best, best_step = None, -1
-    for child in root.iterdir():
-        if child.is_dir() and child.name.startswith("checkpoint_step_"):
-            try:
-                step = int(child.name.rsplit("_", 1)[1])
-            except ValueError:
-                continue
-            if step > best_step:
-                best, best_step = str(child), step
-    return best
+    """Find the newest COMMITTED ``checkpoint_step_{n}`` dir (reference
+    naming trainer.py:100-106). Half-written directories (no COMMIT
+    marker) are never returned — that is the crash-safety contract."""
+    newest = list_checkpoints(checkpoint_root)
+    return newest[0] if newest else None

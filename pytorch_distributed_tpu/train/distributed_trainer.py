@@ -67,6 +67,14 @@ class DistributedTrainer(Trainer):
                 f"pipeline stages must divide the layer stack: n_layer="
                 f"{model_cfg.n_layer} vs pipe={mesh_cfg.pipe}"
             )
+        if train_cfg.anomaly_guard and path != "auto":
+            # The guarded update (train/guard.py) rides the trainer/pjit
+            # step; the hand-scheduled explicit/pipeline bodies would
+            # need their own carry plumbing for the GuardState specs.
+            raise ValueError(
+                f"anomaly_guard is supported on path='auto' (pjit), not "
+                f"path={path!r}"
+            )
         self.mesh = mesh
         self.mesh_cfg = mesh_cfg
         self.path = path
@@ -132,6 +140,7 @@ class DistributedTrainer(Trainer):
                 self.model, self.model_cfg, self.tx, self.mesh,
                 self.mesh_cfg, state,
                 accum_dtype=self.train_cfg.accum_dtype,
+                guard=self.guard_cfg,
             )
         return state
 

@@ -1,0 +1,181 @@
+"""Traced anomaly guard for the training step.
+
+Real training runs die of bad steps, not just bad machines: a corrupt
+shard, a numerically unlucky batch, or a NaN-producing kernel poisons
+the params, and every step after it is wasted compute. The classic
+defence — device_get the loss every step and check it on the host —
+serializes dispatch (the host waits for step N before submitting N+1)
+and costs real throughput. This guard instead runs INSIDE the compiled
+step:
+
+- **Detection is traced.** Three predicates, all computed where the
+  values already live: (1) non-finite loss or gradient norm (the NaN/Inf
+  sentinel — training's twin of the serving engines' logits sentinel),
+  (2) an EMA loss-spike check (``loss > spike_factor * ema`` once the
+  EMA has ``warmup_steps`` clean samples), (3) token ids outside
+  ``[0, vocab)`` in the batch (corrupt data would otherwise be silently
+  clamped by the embedding gather and train on garbage).
+- **The reaction is a traced no-op.** On an anomalous step the params
+  and optimizer state are carried through UNCHANGED (`jnp.where` per
+  leaf); the step counter still advances (it counts consumed data
+  windows). The guard state (EMA + counters) rides ``TrainState`` so
+  everything is one pure ``(state, batch, key) -> (state, metrics)``
+  function: detection adds **zero host syncs per step** and can never
+  recompile — there is ONE program with the anomaly select inside it.
+- **Policy is host-side, at the existing sync.** The host reads the
+  counters at the log-window boundary (where it already device_gets the
+  window's losses) and at save boundaries (which sync anyway). After
+  ``rollback_after`` CONSECUTIVE anomalies the sticky ``trip`` flag is
+  set (traced — a burst entirely inside one window cannot be missed)
+  and the Trainer rolls back to the last good checkpoint
+  (train/trainer.py), optionally skipping the offending data window.
+
+The guard adds no collectives (all three predicates reduce values the
+step already materializes), pinned by the ``train_guard`` audit case
+(analysis/registry.py). See docs/ROBUSTNESS.md §9.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class GuardState(NamedTuple):
+    """Anomaly-guard carry, a few scalars riding TrainState.guard.
+
+    ``ema``/``seen``: exponential moving average of CLEAN losses and how
+    many were folded in (the spike check stays off until ``seen``
+    reaches the warmup). ``consecutive``: current run of anomalous
+    steps (resets on a clean one). ``total``: anomalies since this
+    state was initialised (or restored). ``trip``: sticky 0/1, set the
+    moment ``consecutive`` reaches the rollback threshold — the host's
+    rollback signal, impossible to miss between syncs."""
+
+    ema: jax.Array  # f32 scalar
+    seen: jax.Array  # i32 scalar
+    consecutive: jax.Array  # i32 scalar
+    total: jax.Array  # i32 scalar
+    trip: jax.Array  # i32 scalar (sticky 0/1)
+
+
+def init_guard_state() -> GuardState:
+    return GuardState(
+        ema=jnp.zeros((), jnp.float32),
+        seen=jnp.zeros((), jnp.int32),
+        consecutive=jnp.zeros((), jnp.int32),
+        total=jnp.zeros((), jnp.int32),
+        trip=jnp.zeros((), jnp.int32),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """Static guard parameters (compiled into the step — one program per
+    config, never per anomaly). Built from TrainConfig by the Trainer
+    (``guard_config_from``)."""
+
+    spike_factor: float = 3.0
+    ema_decay: float = 0.98
+    warmup_steps: int = 10
+    # Consecutive anomalies that set the sticky ``trip`` flag (the host
+    # rollback signal). None: never trip — the guard still skips
+    # anomalous updates, it just never asks for a rollback.
+    rollback_after: int | None = 3
+    # Validate token ids against [0, vocab) (0 disables the data check).
+    vocab_size: int = 0
+
+    def __post_init__(self) -> None:
+        if self.spike_factor <= 1.0:
+            raise ValueError(
+                f"spike_factor must be > 1, got {self.spike_factor}"
+            )
+        if not 0.0 < self.ema_decay < 1.0:
+            raise ValueError(
+                f"ema_decay must be in (0, 1), got {self.ema_decay}"
+            )
+        if self.warmup_steps < 1:
+            raise ValueError(
+                f"warmup_steps must be >= 1, got {self.warmup_steps}"
+            )
+        if self.rollback_after is not None and self.rollback_after < 1:
+            raise ValueError(
+                f"rollback_after must be >= 1 or None, got "
+                f"{self.rollback_after}"
+            )
+
+
+def guard_config_from(train_cfg, model_cfg) -> GuardConfig | None:
+    """The TrainConfig -> GuardConfig adapter (None when the guard is
+    off). Lives here so every trainer front-end builds the same guard."""
+    if not train_cfg.anomaly_guard:
+        return None
+    return GuardConfig(
+        spike_factor=train_cfg.guard_spike_factor,
+        ema_decay=train_cfg.guard_ema_decay,
+        warmup_steps=train_cfg.guard_warmup_steps,
+        rollback_after=train_cfg.guard_rollback_after,
+        vocab_size=model_cfg.vocab_size,
+    )
+
+
+def check_batch(batch: dict, vocab_size: int) -> jax.Array:
+    """Traced corrupt-data sentinel: True when any token id in the batch
+    falls outside ``[0, vocab_size)``. Without this, a corrupt shard's
+    garbage ids are silently clamped by the embedding gather and the
+    model trains on noise."""
+    bad = jnp.zeros((), jnp.bool_)
+    for x in (batch["inputs"], batch["targets"]):
+        bad = bad | jnp.any((x < 0) | (x >= vocab_size))
+    return bad
+
+
+def guard_step(
+    guard: GuardState,
+    loss: jax.Array,
+    grad_norm: jax.Array,
+    bad_data: jax.Array,
+    cfg: GuardConfig,
+) -> tuple[GuardState, jax.Array]:
+    """One traced guard update: classify this step, fold a clean loss
+    into the EMA, advance the counters. Returns (new_guard, anomaly)."""
+    nonfinite = ~jnp.isfinite(loss) | ~jnp.isfinite(grad_norm)
+    warmed = guard.seen >= cfg.warmup_steps
+    spike = warmed & (loss > cfg.spike_factor * guard.ema)
+    anomaly = nonfinite | spike | bad_data
+    clean = ~anomaly
+
+    loss32 = loss.astype(jnp.float32)
+    first = guard.seen == 0
+    folded = jnp.where(
+        first, loss32, cfg.ema_decay * guard.ema
+        + (1.0 - cfg.ema_decay) * loss32,
+    )
+    new_ema = jnp.where(clean, folded, guard.ema)
+    new_seen = guard.seen + clean.astype(jnp.int32)
+    new_consecutive = jnp.where(
+        anomaly, guard.consecutive + 1, jnp.zeros((), jnp.int32)
+    )
+    new_total = guard.total + anomaly.astype(jnp.int32)
+    if cfg.rollback_after is not None:
+        new_trip = guard.trip | (
+            new_consecutive >= cfg.rollback_after
+        ).astype(jnp.int32)
+    else:
+        new_trip = guard.trip
+    return (
+        GuardState(new_ema, new_seen, new_consecutive, new_total, new_trip),
+        anomaly,
+    )
+
+
+def apply_guard(anomaly: jax.Array, new_tree, old_tree):
+    """Select the pre-step tree on anomaly, the updated one otherwise —
+    leafwise ``where``, so the update is a traced no-op (same program,
+    same shapes, nothing to recompile)."""
+    return jax.tree.map(
+        lambda n, o: jnp.where(anomaly, o, n), new_tree, old_tree
+    )

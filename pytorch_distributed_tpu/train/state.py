@@ -18,11 +18,18 @@ class TrainState(NamedTuple):
     params: Any
     opt_state: Any
     step: jax.Array  # scalar int32
+    # Anomaly-guard carry (train/guard.GuardState) when the traced guard
+    # is enabled; None otherwise. None is an empty pytree subtree, so
+    # guard-off states flatten/checkpoint/shard exactly as before.
+    guard: Any = None
 
 
-def init_train_state(params, tx) -> TrainState:
+def init_train_state(params, tx, *, guard: Any = None) -> TrainState:
     import jax.numpy as jnp
 
     return TrainState(
-        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
+        params=params,
+        opt_state=tx.init(params),
+        step=jnp.zeros((), jnp.int32),
+        guard=guard,
     )

@@ -22,6 +22,7 @@ responsibilities, TPU-native shape:
 from __future__ import annotations
 
 import contextlib
+import math
 import time
 from pathlib import Path
 from typing import Any, Callable, Iterable
@@ -38,6 +39,8 @@ from pytorch_distributed_tpu.ops.losses import (
     linear_cross_entropy,
 )
 from pytorch_distributed_tpu.train import checkpoint as ckpt_lib
+from pytorch_distributed_tpu.train import guard as guard_lib
+from pytorch_distributed_tpu.train.guard import GuardConfig, guard_config_from
 from pytorch_distributed_tpu.train.optim import lr_at_step, make_optimizer
 from pytorch_distributed_tpu.train.state import TrainState, init_train_state
 from pytorch_distributed_tpu.utils.logging import get_logger
@@ -54,12 +57,20 @@ def make_train_step(
     logits_sharding=None,
     grad_shardings=None,
     accum_dtype: str = "float32",
+    guard: GuardConfig | None = None,
 ) -> Callable:
     """Build the jitted (state, batch, dropout_key) -> (state, metrics) step.
 
     ``batch`` is a dict with "inputs"/"targets" of shape [A, B, T] where A is
     the accumulation factor (A=1 means no accumulation). Gradients are
     averaged over the A micro-batches before one optimizer update.
+
+    ``guard`` (train/guard.py) compiles the traced anomaly guard into the
+    step: non-finite loss/grad + EMA loss-spike + corrupt-token-id
+    detection, with the update selected to a no-op on anomaly and the
+    counters carried in ``state.guard`` — one program, zero per-step host
+    syncs, zero steady-state recompiles. Requires ``state.guard`` to be an
+    initialised GuardState (Trainer.init_state does this).
 
     ``logits_sharding``/``grad_shardings`` (mesh runs only): sharding
     constraints pinned on the [B, T, V] logits and the gradient pytree.
@@ -169,8 +180,28 @@ def make_train_step(
             "loss": loss,
             "grad_norm": optax.global_norm(grads),
         }
+        new_guard = state.guard
+        if guard is not None:
+            bad_data = (
+                guard_lib.check_batch(batch, guard.vocab_size)
+                if guard.vocab_size
+                else jnp.zeros((), jnp.bool_)
+            )
+            new_guard, anomaly = guard_lib.guard_step(
+                state.guard, loss, metrics["grad_norm"], bad_data, guard
+            )
+            # Anomalous step -> traced no-op update: params AND optimizer
+            # state carried through unchanged (the step counter still
+            # advances — it counts consumed data windows).
+            new_params = guard_lib.apply_guard(
+                anomaly, new_params, state.params
+            )
+            new_opt_state = guard_lib.apply_guard(
+                anomaly, new_opt_state, state.opt_state
+            )
+            metrics["anomaly"] = anomaly
         return (
-            TrainState(new_params, new_opt_state, state.step + 1),
+            TrainState(new_params, new_opt_state, state.step + 1, new_guard),
             metrics,
         )
 
@@ -241,17 +272,26 @@ class Trainer:
         self.train_cfg = train_cfg
         self.accum = train_cfg.grad_accum_steps(data_parallel_size)
         self.tx = make_optimizer(train_cfg)
+        self.guard_cfg = guard_config_from(train_cfg, model_cfg)
         self.train_step = (
             train_step
             if train_step is not None
             else make_train_step(
                 model, model_cfg, self.tx,
                 accum_dtype=train_cfg.accum_dtype,
+                guard=self.guard_cfg,
             )
         )
         self._put_batch = put_batch or (lambda b: b)
         self._dropout_root = domain_key(train_cfg.seed, "dropout")
         self._log = log_fn or get_logger().info
+        self._injector = None  # train/chaos.TrainFaultInjector (or None)
+
+    def set_fault_injector(self, injector) -> None:
+        """Install a train/chaos.TrainFaultInjector (or None to remove):
+        host-side hooks at the step/save boundaries — nothing traced ever
+        sees it (docs/ROBUSTNESS.md §11)."""
+        self._injector = injector
 
     # -- state ------------------------------------------------------------
     def init_state(self, init_key: jax.Array | None = None) -> TrainState:
@@ -261,7 +301,12 @@ class Trainer:
             else domain_key(self.train_cfg.seed, "init")
         )
         params = self.model.init(key, self.model_cfg)
-        return init_train_state(params, self.tx)
+        g = (
+            guard_lib.init_guard_state()
+            if self.guard_cfg is not None
+            else None
+        )
+        return init_train_state(params, self.tx, guard=g)
 
     # -- checkpointing (reference trainer.py:100-141) ---------------------
     def checkpoint_path(self, step: int) -> Path:
@@ -287,10 +332,13 @@ class Trainer:
             if self.train_cfg.keep_checkpoints is not None:
                 # The PREVIOUS save just became visible — prune now so
                 # disk stays bounded during the run, not only at its end.
+                # (prune_checkpoints itself excludes the in-flight save's
+                # target, so the fire-and-forget write is never raced.)
                 ckpt_lib.prune_checkpoints(
                     self.train_cfg.checkpoint_dir,
                     self.train_cfg.keep_checkpoints,
                 )
+            self._after_save()
             return path
         path = ckpt_lib.save_checkpoint(
             self.checkpoint_path(step),
@@ -303,25 +351,118 @@ class Trainer:
                 self.train_cfg.checkpoint_dir,
                 self.train_cfg.keep_checkpoints,
             )
+        self._after_save()
         return path
+
+    def _after_save(self) -> None:
+        if self._injector is not None:
+            self._injector.after_save(self.train_cfg.checkpoint_dir)
 
     def load_checkpoint(self, path: str | Path, state: TrainState) -> TrainState:
         return ckpt_lib.load_checkpoint(path, state)
+
+    def _load_latest_good(
+        self, state: TrainState
+    ) -> tuple[TrainState, str] | None:
+        """Walk the retained checkpoints newest-first and load the first
+        one that passes integrity verification, logging every corrupt
+        candidate skipped. None when no checkpoints exist; raises
+        ``CheckpointCorrupt`` when checkpoints exist but ALL fail (a
+        silent from-scratch restart would be data loss)."""
+        candidates = ckpt_lib.list_checkpoints(self.train_cfg.checkpoint_dir)
+        if not candidates:
+            stray = ckpt_lib.uncommitted_checkpoints(
+                self.train_cfg.checkpoint_dir
+            )
+            if stray:
+                # Checkpoint-shaped dirs with no COMMIT marker: half-
+                # written saves, or the pre-integrity on-disk format.
+                # Starting over next to them must not look like a clean
+                # first run.
+                names = ", ".join(Path(s).name for s in stray[:3])
+                self._log(
+                    f"WARNING: no committed checkpoint in "
+                    f"{self.train_cfg.checkpoint_dir}, but {len(stray)} "
+                    f"checkpoint dir(s) without a COMMIT marker exist "
+                    f"({names}{', ...' if len(stray) > 3 else ''}): "
+                    "half-written saves or pre-integrity-format "
+                    "checkpoints — not resumable; training starts fresh"
+                )
+            return None
+        for path in candidates:
+            try:
+                return self.load_checkpoint(path, state), path
+            except ckpt_lib.CheckpointCorrupt as e:
+                self._log(
+                    f"checkpoint {path} failed integrity verification "
+                    f"({e}); falling back to the next-older retained "
+                    "checkpoint"
+                )
+        raise ckpt_lib.CheckpointCorrupt(
+            f"all {len(candidates)} retained checkpoints in "
+            f"{self.train_cfg.checkpoint_dir} failed verification"
+        )
 
     def resume_latest(
         self, state: TrainState, *, loader: Any | None = None
     ) -> TrainState:
         # An in-flight async save is invisible until finalized.
         ckpt_lib.finalize_async_save()
-        latest = ckpt_lib.latest_checkpoint(self.train_cfg.checkpoint_dir)
-        if latest is None:
+        loaded = self._load_latest_good(state)
+        if loaded is None:
             return state
-        self._log(f"resuming from {latest}")
+        restored, path = loaded
+        self._log(f"resuming from {path}")
         if loader is not None and hasattr(loader, "load_state_dict"):
-            meta = ckpt_lib.read_metadata(latest)
+            meta = ckpt_lib.read_metadata(path)
             if "loader_state" in meta:
                 loader.load_state_dict(meta["loader_state"])
-        return self.load_checkpoint(latest, state)
+        return restored
+
+    def _guard_rollback(self, state: TrainState, dataloader, groups):
+        """The guard tripped (guard_rollback_after consecutive anomalies):
+        restore the newest loadable checkpoint, rewind the data stream to
+        its position (unless guard_skip_window — the policy for
+        persistent data corruption), and continue. Returns the restored
+        (state, groups, step). Raises loudly when no checkpoint is
+        loadable or guard_max_rollbacks is exhausted — a thrashing run
+        must fail, not spin."""
+        cfg = self.train_cfg
+        self._rollbacks += 1
+        if self._rollbacks > cfg.guard_max_rollbacks:
+            raise RuntimeError(
+                f"anomaly guard rolled back {cfg.guard_max_rollbacks} "
+                "times in one run and tripped again — the anomaly is "
+                "persistent; inspect the data/numerics (or set "
+                "guard_skip_window=True for corrupt-data streams)"
+            )
+        ckpt_lib.finalize_async_save()
+        loaded = self._load_latest_good(state)
+        if loaded is None:
+            raise RuntimeError(
+                "anomaly guard tripped but no checkpoint exists to roll "
+                "back to; set save_every_n_steps (or disable rollback "
+                "with guard_rollback_after=None)"
+            )
+        restored, path = loaded
+        rewound = False
+        if not cfg.guard_skip_window:
+            meta = ckpt_lib.read_metadata(path)
+            if hasattr(dataloader, "load_state_dict") and (
+                "loader_state" in meta
+            ):
+                dataloader.load_state_dict(meta["loader_state"])
+                groups = self._grouped_batches(dataloader)
+                rewound = True
+        new_step = int(jax.device_get(restored.step))
+        self._log(
+            f"anomaly guard tripped: rolled back to {path} (step "
+            f"{new_step}, rollback {self._rollbacks}/"
+            f"{cfg.guard_max_rollbacks}); data stream "
+            + ("rewound and replayed" if rewound else
+               "NOT rewound — offending window skipped")
+        )
+        return restored, groups, new_step
 
     # -- data grouping ----------------------------------------------------
     def _grouped_batches(self, dataloader: Iterable):
@@ -410,6 +551,7 @@ class Trainer:
         # next batch group, or the saved loader position skips data the
         # resumed run never trains on.
         groups = self._grouped_batches(dataloader)
+        self._rollbacks = 0
         try:
             while step < num_steps:
               if stop_requested():
@@ -417,6 +559,14 @@ class Trainer:
               batch = next(groups, None)
               if batch is None:
                   break
+              if self._injector is not None:
+                  # Host-side chaos hooks (train/chaos.py): arm this
+                  # step's faults, then let the injector crash/signal/
+                  # poison BEFORE dispatch — the compiled step only ever
+                  # sees a (possibly corrupt) batch, exactly like
+                  # production.
+                  self._injector.on_step(step + 1)
+                  batch = self._injector.before_step(step + 1, batch)
               dkey = step_key(self._dropout_root, step)
               ctx = (
                   profiler.step_context(step)
@@ -439,26 +589,72 @@ class Trainer:
                       float(x) for x in jax.device_get(window_losses)
                   ]  # single sync point for the whole window
                   elapsed = time.perf_counter() - t0
-                  avg_loss = sum(losses) / len(losses)
                   lr = lr_at_step(cfg, new_step)
-                  self._log(
-                      f"step {new_step}/{num_steps} | loss {avg_loss:.4f} | "
-                      f"lr {lr:.2e} | elapsed {elapsed:.1f}s"
-                  )
                   entry = {
                       "step": new_step,
-                      "loss": avg_loss,
                       "lr": lr,
                       "elapsed_s": elapsed,
                   }
+                  if self.guard_cfg is not None:
+                      # The guard counters ride the SAME sync the window
+                      # losses already pay — reading them here adds no
+                      # per-step cost. Non-finite (skipped) losses are
+                      # excluded from the window average so one NaN step
+                      # does not turn the whole window's log line NaN.
+                      g = jax.device_get(state.guard)
+                      finite = [x for x in losses if math.isfinite(x)]
+                      avg_loss = (
+                          sum(finite) / len(finite)
+                          if finite
+                          else float("nan")
+                      )
+                      entry["anomalies"] = int(g.total)
+                      suffix = (
+                          f" | anomalies {int(g.total)}"
+                          if int(g.total)
+                          else ""
+                      )
+                  else:
+                      avg_loss = sum(losses) / len(losses)
+                      suffix = ""
+                  entry["loss"] = avg_loss
+                  self._log(
+                      f"step {new_step}/{num_steps} | loss {avg_loss:.4f} | "
+                      f"lr {lr:.2e} | elapsed {elapsed:.1f}s{suffix}"
+                  )
                   history.append(entry)
                   self._write_metrics(entry)
                   window_losses = []
+                  if self.guard_cfg is not None and int(g.trip):
+                      state, groups, step = self._guard_rollback(
+                          state, dataloader, groups
+                      )
+                      continue
 
               if (
                   cfg.save_every_n_steps
                   and new_step % cfg.save_every_n_steps == 0
               ):
+                  if self.guard_cfg is not None:
+                      # A checkpoint must never capture un-adjudicated
+                      # anomalies: a later rollback would land on a state
+                      # that silently missed the poisoned window's clean
+                      # replay. The save already syncs, so this read is
+                      # free.
+                      g = jax.device_get(state.guard)
+                      if int(g.trip):
+                          window_losses = []
+                          state, groups, step = self._guard_rollback(
+                              state, dataloader, groups
+                          )
+                          continue
+                      if int(g.consecutive) > 0:
+                          self._log(
+                              f"deferring checkpoint at step {new_step}: "
+                              f"anomaly burst in progress "
+                              f"({int(g.consecutive)} consecutive)"
+                          )
+                          continue
                   self.save_checkpoint(state, loader=dataloader)
         finally:
             if restore_handlers:
@@ -479,11 +675,64 @@ class Trainer:
         # final decision always syncs (exactly once per process) even when
         # the in-loop cadence is gated, so a signal deferred past the last
         # loop iteration is still honoured.
+        if self.guard_cfg is not None and step < num_steps:
+            # The loop ended early (data exhausted / stop requested)
+            # between boundaries: a pending trip would otherwise vanish
+            # without adjudication. There is no data left to replay, so
+            # the honest move is to say so loudly — the last good
+            # checkpoint is the trustworthy resume point.
+            g_exit = jax.device_get(state.guard)
+            if int(g_exit.trip) or int(g_exit.consecutive):
+                self._log(
+                    f"WARNING: training ended at step {step} with "
+                    f"un-adjudicated anomalies (consecutive "
+                    f"{int(g_exit.consecutive)}, trip {int(g_exit.trip)}): "
+                    "the returned state skipped anomalous windows without "
+                    "rollback; resume from the last good checkpoint to "
+                    "replay them"
+                )
         if cfg.save_on_preemption and stop_requested(force_sync=True):
-            self._log(
-                f"preemption signal received: checkpointing at step {step}"
-            )
-            self.save_checkpoint(state, loader=dataloader)
+            skip_save = False
+            if self.guard_cfg is not None:
+                # Same clean-history rule as the in-loop save gating: a
+                # preemption checkpoint carrying un-adjudicated anomalies
+                # (skipped update, trip pending) would anchor every later
+                # resume on a state that silently lost the poisoned
+                # window's replay. Resume from the last GOOD checkpoint
+                # instead — correctness over a few replayed steps.
+                g = jax.device_get(state.guard)
+                if int(g.trip) or int(g.consecutive):
+                    ckpt_lib.finalize_async_save()
+                    prior = ckpt_lib.latest_checkpoint(
+                        cfg.checkpoint_dir
+                    )
+                    # Skip ONLY when a good checkpoint exists to resume
+                    # from — an anomaly-tainted checkpoint still beats
+                    # losing the whole run.
+                    skip_save = prior is not None
+                    if skip_save:
+                        self._log(
+                            f"preemption checkpoint at step {step} "
+                            f"SKIPPED: un-adjudicated anomalies "
+                            f"(consecutive {int(g.consecutive)}, trip "
+                            f"{int(g.trip)}); resume replays from "
+                            f"{prior}"
+                        )
+                    else:
+                        self._log(
+                            f"WARNING: preemption checkpoint at step "
+                            f"{step} carries un-adjudicated anomalies "
+                            f"(consecutive {int(g.consecutive)}, trip "
+                            f"{int(g.trip)}) — saved anyway, no earlier "
+                            "checkpoint exists; the skipped windows "
+                            "were not replayed"
+                        )
+            if not skip_save:
+                self._log(
+                    f"preemption signal received: checkpointing at step "
+                    f"{step}"
+                )
+                self.save_checkpoint(state, loader=dataloader)
 
         if cfg.async_checkpoint:
             # Durability boundary: the last in-flight save must be

@@ -72,6 +72,21 @@ def add_common_args(p: argparse.ArgumentParser, *, preset: str) -> None:
                    help="on SIGTERM/SIGINT, finish the in-flight step, "
                         "write a resumable checkpoint (incl. data-stream "
                         "position), and exit cleanly")
+    p.add_argument("--anomaly-guard", action="store_true",
+                   help="traced anomaly guard (train/guard.py): non-finite "
+                        "loss/grad + EMA loss-spike + corrupt-token "
+                        "detection INSIDE the compiled step; anomalous "
+                        "updates become traced no-ops (zero host syncs, "
+                        "zero recompiles) and the host rolls back to the "
+                        "last good checkpoint after --guard-rollback-after "
+                        "consecutive anomalies")
+    p.add_argument("--guard-rollback-after", type=int, default=3,
+                   help="consecutive anomalies before rollback "
+                        "(0 = skip-only, never roll back)")
+    p.add_argument("--guard-skip-window", action="store_true",
+                   help="on rollback, drop the offending data window "
+                        "instead of replaying it (for persistent data "
+                        "corruption)")
     p.add_argument("--resume", action="store_true",
                    help="resume from latest checkpoint (capability the "
                         "reference has at trainer level but never wires up)")
@@ -177,6 +192,12 @@ def build_train_cfg(args, *, data_parallel_size: int = 1):
         async_checkpoint=args.async_checkpoint,
         metrics_path=args.metrics_out,
         save_on_preemption=args.save_on_preemption,
+        anomaly_guard=args.anomaly_guard,
+        guard_rollback_after=(
+            args.guard_rollback_after if args.guard_rollback_after > 0
+            else None
+        ),
+        guard_skip_window=args.guard_skip_window,
     )
     cfg.grad_accum_steps(data_parallel_size)  # validate divisibility early
     return cfg

@@ -1,0 +1,284 @@
+"""Traced anomaly guard (train/guard.py + make_train_step(guard=...)).
+
+The contract under test (docs/ROBUSTNESS.md §9): detection runs INSIDE
+the one compiled step (non-finite loss/grads, EMA loss spike, corrupt
+token ids), an anomalous step's update is a traced no-op (params AND
+opt_state carried bit-unchanged), the counters ride TrainState.guard,
+and none of it can recompile (compile-count pinned) or add a collective
+(the ``train_guard`` audit case pins that side).
+"""
+
+import jax
+import jax.numpy as jnp
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+
+from pytorch_distributed_tpu.config import TrainConfig
+from pytorch_distributed_tpu.models import get_model
+from pytorch_distributed_tpu.train.guard import (
+    GuardConfig,
+    GuardState,
+    apply_guard,
+    check_batch,
+    guard_config_from,
+    guard_step,
+    init_guard_state,
+)
+from pytorch_distributed_tpu.train.optim import make_optimizer
+from pytorch_distributed_tpu.train.state import init_train_state
+from pytorch_distributed_tpu.train.trainer import make_train_step
+from pytorch_distributed_tpu.utils.prng import domain_key
+
+
+def _cfg(**kw):
+    base = dict(warmup_steps=2, rollback_after=2, vocab_size=0)
+    base.update(kw)
+    return GuardConfig(**base)
+
+
+def _run(guard, loss, grad_norm=1.0, bad=False, cfg=None):
+    cfg = cfg or _cfg()
+    step = jax.jit(lambda g, l, n, b: guard_step(g, l, n, b, cfg))
+    return step(
+        guard,
+        jnp.asarray(loss, jnp.float32),
+        jnp.asarray(grad_norm, jnp.float32),
+        jnp.asarray(bad),
+    )
+
+
+def test_guard_step_clean_folds_ema():
+    g = init_guard_state()
+    g, a = _run(g, 4.0)
+    assert not bool(a)
+    assert float(g.ema) == pytest.approx(4.0)  # first clean loss seeds it
+    assert int(g.seen) == 1 and int(g.total) == 0
+    g, a = _run(g, 2.0)
+    assert not bool(a)
+    assert float(g.ema) == pytest.approx(0.98 * 4.0 + 0.02 * 2.0)
+    assert int(g.seen) == 2
+
+
+@pytest.mark.parametrize(
+    "loss,grad_norm",
+    [(float("nan"), 1.0), (float("inf"), 1.0), (4.0, float("nan"))],
+)
+def test_guard_step_nonfinite(loss, grad_norm):
+    g = init_guard_state()
+    g, a = _run(g, loss, grad_norm)
+    assert bool(a)
+    assert int(g.consecutive) == 1 and int(g.total) == 1
+    assert int(g.seen) == 0 and float(g.ema) == 0.0  # anomaly never folds
+    assert int(g.trip) == 0  # rollback_after=2: one anomaly is no trip
+
+
+def test_guard_step_spike_only_after_warmup():
+    cfg = _cfg(spike_factor=3.0, warmup_steps=2)
+    g = init_guard_state()
+    # First clean loss seeds the EMA; a 100x jump on the very next step
+    # is NOT a spike yet (seen=1 < warmup) — early training is volatile.
+    g, a = _run(g, 1.0, cfg=cfg)
+    g, a = _run(g, 100.0, cfg=cfg)
+    assert not bool(a)
+    g, a = _run(g, 1.0, cfg=cfg)
+    assert not bool(a)
+    assert int(g.seen) == 3
+    # Warmed up now: > spike_factor * ema flags.
+    g, a = _run(g, 1000.0, cfg=cfg)
+    assert bool(a)
+    # The spike is NOT folded into the EMA (one outlier must not drag
+    # the baseline up and mask the next one).
+    g2, a2 = _run(g, 1000.0, cfg=cfg)
+    assert bool(a2)
+    assert int(g2.consecutive) == 2 and int(g2.trip) == 1
+
+
+def test_guard_consecutive_resets_and_trip_sticks():
+    cfg = _cfg(rollback_after=2)
+    g = init_guard_state()
+    g, _ = _run(g, float("nan"), cfg=cfg)
+    g, _ = _run(g, 1.0, cfg=cfg)
+    assert int(g.consecutive) == 0 and int(g.total) == 1
+    assert int(g.trip) == 0
+    g, _ = _run(g, float("nan"), cfg=cfg)
+    g, _ = _run(g, float("nan"), cfg=cfg)
+    assert int(g.trip) == 1
+    # Sticky: a clean step cannot clear the host's rollback signal (a
+    # burst entirely inside one log window would otherwise be missed).
+    g, _ = _run(g, 1.0, cfg=cfg)
+    assert int(g.trip) == 1 and int(g.consecutive) == 0
+
+
+def test_guard_rollback_disabled_never_trips():
+    cfg = _cfg(rollback_after=None)
+    g = init_guard_state()
+    for _ in range(5):
+        g, _ = _run(g, float("nan"), cfg=cfg)
+    assert int(g.total) == 5 and int(g.trip) == 0
+
+
+def test_check_batch_flags_out_of_range():
+    b = {
+        "inputs": jnp.zeros((2, 4, 8), jnp.int32),
+        "targets": jnp.zeros((2, 4, 8), jnp.int32),
+    }
+    assert not bool(check_batch(b, 101))
+    bad = {**b, "inputs": b["inputs"].at[0, 0, 0].set(-1)}
+    assert bool(check_batch(bad, 101))
+    bad = {**b, "targets": b["targets"].at[1, 3, 7].set(101)}
+    assert bool(check_batch(bad, 101))
+
+
+def test_apply_guard_selects_old_tree():
+    old = {"a": jnp.ones((3,)), "b": jnp.zeros((), jnp.int32)}
+    new = {"a": jnp.full((3,), 2.0), "b": jnp.ones((), jnp.int32)}
+    kept = apply_guard(jnp.asarray(True), new, old)
+    assert jnp.array_equal(kept["a"], old["a"])
+    assert int(kept["b"]) == 0
+    passed = apply_guard(jnp.asarray(False), new, old)
+    assert jnp.array_equal(passed["a"], new["a"])
+
+
+def test_guard_config_validation():
+    with pytest.raises(ValueError, match="spike_factor"):
+        GuardConfig(spike_factor=1.0)
+    with pytest.raises(ValueError, match="ema_decay"):
+        GuardConfig(ema_decay=1.0)
+    with pytest.raises(ValueError, match="rollback_after"):
+        GuardConfig(rollback_after=0)
+    with pytest.raises(ValueError, match="warmup_steps"):
+        GuardConfig(warmup_steps=0)
+    # TrainConfig validates at construction, not at the first anomaly.
+    with pytest.raises(ValueError, match="spike_factor"):
+        TrainConfig(anomaly_guard=True, guard_spike_factor=0.5)
+    with pytest.raises(ValueError, match="guard_max_rollbacks"):
+        TrainConfig(anomaly_guard=True, guard_max_rollbacks=0)
+    # Off: guard knobs are not even looked at.
+    assert guard_config_from(TrainConfig(), None) is None
+
+
+def _guarded_step_setup(tiny_config, rollback_after=1):
+    cfg = tiny_config.replace(
+        embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0
+    )
+    model = get_model(cfg)
+    tcfg = TrainConfig(
+        global_batch_size=8, micro_batch_size=4, learning_rate=1e-3
+    )
+    tx = make_optimizer(tcfg)
+    guard = GuardConfig(
+        rollback_after=rollback_after, warmup_steps=2,
+        vocab_size=cfg.vocab_size,
+    )
+    # repolint: allow(jit-donation-decision) — donate off so the test can
+    # compare pre/post-step trees bit-exactly.
+    step = make_train_step(model, cfg, tx, donate=False, guard=guard)
+    state = init_train_state(
+        model.init(domain_key(3, "init"), cfg), tx,
+        guard=init_guard_state(),
+    )
+    rng = np.random.default_rng(0)
+
+    def mk(bad=False):
+        b = {
+            "inputs": rng.integers(0, 101, (2, 4, 16)).astype(np.int32),
+            "targets": rng.integers(0, 101, (2, 4, 16)).astype(np.int32),
+        }
+        if bad:
+            b["inputs"][0, 0, :4] = -1
+        return b
+
+    return step, state, mk
+
+
+def test_train_step_guard_noop_on_corrupt_batch(tiny_config):
+    """A corrupt batch through the REAL train step: anomaly flagged,
+    params AND opt_state bit-unchanged, step still advances, and the
+    whole ordeal compiles exactly one executable."""
+    step, state, mk = _guarded_step_setup(tiny_config)
+    key = jax.random.key(0)
+    s1, m1 = step(state, mk(), key)
+    assert not bool(m1["anomaly"])
+    s2, m2 = step(s1, mk(bad=True), key)
+    assert bool(m2["anomaly"])
+    for a, b in zip(
+        jtu.tree_leaves(jax.device_get(s1.params)),
+        jtu.tree_leaves(jax.device_get(s2.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jtu.tree_leaves(jax.device_get(s1.opt_state)),
+        jtu.tree_leaves(jax.device_get(s2.opt_state)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(s2.step) == 2  # the step counter counts data windows
+    assert int(s2.guard.consecutive) == 1 and int(s2.guard.trip) == 1
+    # Clean step after: updates resume, consecutive resets.
+    s3, m3 = step(s2, mk(), key)
+    assert not bool(m3["anomaly"])
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jtu.tree_leaves(jax.device_get(s2.params)),
+            jtu.tree_leaves(jax.device_get(s3.params)),
+        )
+    )
+    assert changed
+    assert int(s3.guard.consecutive) == 0
+    # Compile pin: clean and anomalous steps are ONE program.
+    assert step._cache_size() == 1
+
+
+def test_train_step_guard_noop_on_nan_params(tiny_config):
+    """Genuinely-NaN compute (poisoned params) fires the non-finite
+    sentinel through the real loss/grad path."""
+    step, state, mk = _guarded_step_setup(tiny_config)
+    key = jax.random.key(0)
+    leaves, treedef = jtu.tree_flatten(state.params)
+    leaves[0] = leaves[0].at[(0,) * leaves[0].ndim].set(jnp.nan)
+    poisoned = state._replace(params=jtu.tree_unflatten(treedef, leaves))
+    s1, m1 = step(poisoned, mk(), key)
+    assert bool(m1["anomaly"])
+    assert int(s1.guard.total) == 1
+    # No-op carries the (poisoned) input params bit-unchanged — recovery
+    # from poisoned PARAMS is the host rollback's job, not the select's.
+    for a, b in zip(
+        jtu.tree_leaves(jax.device_get(poisoned.params)),
+        jtu.tree_leaves(jax.device_get(s1.params)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert step._cache_size() == 1
+
+
+def test_guard_state_rides_checkpoints(tiny_config, tmp_path):
+    """TrainState.guard leaves save/load like any other state — a
+    resumed run continues the EMA and counters exactly."""
+    from pytorch_distributed_tpu.train.checkpoint import (
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    step, state, mk = _guarded_step_setup(tiny_config)
+    s1, _ = step(state, mk(), jax.random.key(0))
+    save_checkpoint(tmp_path / "c", s1)
+    fresh = state  # same treedef, different values
+    restored = load_checkpoint(tmp_path / "c", fresh)
+    for a, b in zip(
+        jtu.tree_leaves(jax.device_get(s1.guard)),
+        jtu.tree_leaves(jax.device_get(restored.guard)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_guard_off_state_unchanged(tiny_config):
+    """guard=None keeps TrainState's pytree EXACTLY as before (guard leaf
+    absent), so checkpoints, shardings, and donation are untouched."""
+    state = init_train_state({"w": jnp.ones((2,))}, make_optimizer(
+        TrainConfig(global_batch_size=8, micro_batch_size=8)
+    ))
+    assert state.guard is None
+    assert all(
+        "guard" not in str(path)
+        for path, _ in jtu.tree_flatten_with_path(state)[0]
+    )
