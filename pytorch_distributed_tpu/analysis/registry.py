@@ -242,6 +242,39 @@ def _build_batched_engine(
     }
 
 
+def _build_paged_engine(
+    kind: str,
+    budget: CollectiveBudget | None = NO_COLLECTIVES,
+):
+    """A paged slot-batched serving program
+    (serving/engine.PagedBatchedDecodeEngine): the EXACT jitted chunked
+    prefill / block-table decode step the scheduler dispatches. Block
+    tables are traced int32 operands, so — like the dense batched cases
+    — one executable covers every table content, and the audited
+    contract is strict donation of the WHOLE page pool (a rejected
+    alias would double-buffer the pool every token) plus NO_COLLECTIVES
+    on the single-device programs."""
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.serving.engine import (
+        PagedBatchedDecodeEngine,
+    )
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _tiny()
+    params = get_model(cfg).init(domain_key(42, "init"), cfg)
+    engine = PagedBatchedDecodeEngine(
+        cfg, slots=4, max_len=16, page_size=8, pool_pages=8,
+        prefill_chunk=8,
+    )
+    fn = engine.program(kind)
+    args = engine.example_args(kind, engine._place_params(params))
+    return fn, args, budget, {
+        "compute_dtype": cfg.dtype,
+        "donate_argnums": (engine.CACHE_ARGNUM[kind],),
+        "donation_strict": True,
+    }
+
+
 def _build_pipeline(schedule: str):
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.parallel import make_mesh
@@ -501,6 +534,24 @@ def registered_cases() -> dict[str, AuditCase]:
                 ),
                 budget_case="decode_batched_step_tp",
             ),
+        ),
+        # Paged slot-batched serving programs (block-pool KV cache):
+        # chunked prefill + block-table decode; the donated buffer is the
+        # whole page pool, and the tables are traced operands — one
+        # executable per program regardless of allocation pattern.
+        AuditCase(
+            "decode_paged_prefill",
+            "paged chunked prefill (per-row start/valid + block tables, "
+            "donated page pool): single device, any collective is a bug",
+            1,
+            lambda: _build_paged_engine("prefill"),
+        ),
+        AuditCase(
+            "decode_paged_step",
+            "paged decode step (block-table page indirection, donated "
+            "page pool): single device, any collective is a bug",
+            1,
+            lambda: _build_paged_engine("decode_step"),
         ),
         # pjit twins of the explicit cases (parallel/api.py). Budgets per
         # _build_pjit's docstring: derived where the partitioner's op set

@@ -79,13 +79,64 @@ def init_cache(
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def _cached_attention(q, ck, cv, pos):
+def init_paged_cache(
+    cfg: ModelConfig, pool_pages: int, page_size: int, dtype=None,
+    n_kv: int | None = None,
+) -> Cache:
+    """Preallocate a PAGED [L, pool_pages, page_size, Hkv, D] key/value
+    pool pair (serving/block_pool.py owns the host-side allocation; page
+    0 is the reserved scratch page). ``n_kv`` as in ``init_cache``."""
+    dtype = jnp.dtype(dtype or cfg.dtype)
+    shape = (
+        cfg.n_layer, pool_pages, page_size, n_kv or cfg.kv_heads,
+        cfg.head_dim,
+    )
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def gather_pages(cache_layer: jax.Array, block_tables: jax.Array):
+    """[P, page, Hkv, D] pool + [B, n_pages] tables -> the [B, S, Hkv, D]
+    contiguous per-row view dense attention expects (S = n_pages * page).
+    Unallocated table entries point at the scratch page — garbage the
+    ``pos`` mask already excludes, exactly like a dense row's unwritten
+    tail. This is the XLA fallback the CPU rig runs; the Pallas decode
+    kernel (ops/paged_kernel.py) reads pages in place instead."""
+    b, n_pages = block_tables.shape
+    page, hkv, d = cache_layer.shape[1:]
+    return cache_layer[block_tables].reshape(b, n_pages * page, hkv, d)
+
+
+def _cached_attention(q, ck, cv, pos, block_tables=None,
+                      paged_impl="gather"):
     """q [B, T, H, D] against the full cache [B, S, Hkv, D]; queries sit at
     global positions pos..pos+T-1, keys j are valid iff j <= pos + i.
     ``pos`` is a scalar (every row at the same position — the single-request
     paths) or a [B] vector (slot-batched decode: each row carries its own
     position, so each row's mask — and therefore which cache rows it can
-    ever read — is independent of its neighbours)."""
+    ever read — is independent of its neighbours).
+
+    ``block_tables`` [B, n_pages] switches to the PAGED cache layout
+    (ck/cv are [P, page, Hkv, D] pools): the gather fallback materialises
+    the per-row view and runs the identical masked math (bit-equal to the
+    dense path wherever the valid positions hold the same values); for
+    single-token decode, ``paged_impl`` of "kernel"/"kernel_interpret"
+    dispatches the Pallas paged-attention kernel instead, which reads
+    pages in place and skips pages past each row's depth."""
+    if block_tables is not None and q.shape[1] == 1 and (
+        paged_impl in ("kernel", "kernel_interpret")
+    ):
+        from pytorch_distributed_tpu.ops.paged_kernel import (
+            paged_decode_attention,
+        )
+
+        out = paged_decode_attention(
+            q[:, 0], ck, cv, block_tables, pos,
+            interpret=paged_impl == "kernel_interpret",
+        )
+        return out[:, None]
+    if block_tables is not None:
+        ck = gather_pages(ck, block_tables)
+        cv = gather_pages(cv, block_tables)
     b, t, h, d = q.shape
     s, hkv = ck.shape[1], ck.shape[2]
     if hkv != h:
@@ -106,13 +157,28 @@ def _cached_attention(q, ck, cv, pos):
     return jnp.einsum("bhts,bshd->bthd", w, cv)
 
 
-def _write(cache_layer, new, pos):
+def _write(cache_layer, new, pos, block_tables=None):
     """Insert new [B, T, Hkv, D] at time offset pos. A [B] vector pos
     writes each row at ITS OWN offset (slot-batched decode) via a vmapped
     per-row update — pure data movement either way, so a row written at
     pos[b] holds bit-identical values to the scalar-pos write at the same
-    offset."""
+    offset.
+
+    With ``block_tables`` [B, n_pages] the cache layer is a PAGED pool
+    [P, page, Hkv, D]: token i of row b lands at page
+    ``table[b, (pos[b]+i) // page]``, offset ``(pos[b]+i) % page`` — one
+    scatter, pure data movement again. The host guarantees distinct live
+    rows write distinct pages (the copy-on-write discipline of
+    serving/block_pool.py), so the scatter has no cross-row collisions;
+    free rows' tables are all-zero, colliding harmlessly on the
+    never-read scratch page."""
     new = new.astype(cache_layer.dtype)
+    if block_tables is not None:
+        page = cache_layer.shape[1]
+        b, t = new.shape[:2]
+        gpos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [B,T]
+        pids = jnp.take_along_axis(block_tables, gpos // page, axis=1)
+        return cache_layer.at[pids, gpos % page].set(new)
     if getattr(pos, "ndim", 0):
         return jax.vmap(
             lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
@@ -143,14 +209,18 @@ def _moe_mlp(m, mlp_params, cfg, act, tensor_axis=None):
     return out
 
 
-def _gpt2_block(x, bp, ck, cv, pos, cfg, tensor_axis=None):
+def _gpt2_block(x, bp, ck, cv, pos, cfg, tensor_axis=None,
+                block_tables=None, paged_impl="gather"):
     eps = cfg.layer_norm_epsilon
     b, t = x.shape[:2]
     a = layer_norm(x, bp["ln_1"], eps=eps)
     qkv = dense(a, bp["attn"]["c_attn"])  # [B, T, 3, H(/tp), D]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    ck, cv = _write(ck, k, pos), _write(cv, v, pos)
-    a = _cached_attention(q, ck, cv, pos).reshape(b, t, -1)
+    ck = _write(ck, k, pos, block_tables)
+    cv = _write(cv, v, pos, block_tables)
+    a = _cached_attention(
+        q, ck, cv, pos, block_tables, paged_impl
+    ).reshape(b, t, -1)
     x = x + dense(a, bp["attn"]["c_proj"], tp_reduce_axis=tensor_axis)
     m = layer_norm(x, bp["ln_2"], eps=eps)
     act = activation(cfg.activation_function)
@@ -161,7 +231,8 @@ def _gpt2_block(x, bp, ck, cv, pos, cfg, tensor_axis=None):
     return x + dense(m, bp["mlp"]["c_proj"], tp_reduce_axis=tensor_axis), ck, cv
 
 
-def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin, tensor_axis=None):
+def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin, tensor_axis=None,
+                 block_tables=None, paged_impl="gather"):
     from pytorch_distributed_tpu.ops.tp import tp_reduce
 
     eps = cfg.layer_norm_epsilon
@@ -171,8 +242,11 @@ def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin, tensor_axis=None):
     q = apply_rope((a @ bp["attn"]["wq"].astype(a.dtype)).reshape(b, t, -1, d), cos, sin)
     k = apply_rope((a @ bp["attn"]["wk"].astype(a.dtype)).reshape(b, t, -1, d), cos, sin)
     v = (a @ bp["attn"]["wv"].astype(a.dtype)).reshape(b, t, -1, d)
-    ck, cv = _write(ck, k, pos), _write(cv, v, pos)
-    a = _cached_attention(q, ck, cv, pos).reshape(b, t, -1)
+    ck = _write(ck, k, pos, block_tables)
+    cv = _write(cv, v, pos, block_tables)
+    a = _cached_attention(
+        q, ck, cv, pos, block_tables, paged_impl
+    ).reshape(b, t, -1)
     x = x + tp_reduce(a @ bp["attn"]["wo"].astype(a.dtype), tensor_axis)
     m = rms_norm(x, bp["ln_mlp"], eps=eps)
     if cfg.n_experts:
@@ -193,11 +267,21 @@ def forward(
     tensor_axis: str | None = None,
     block_transform=None,
     prefetch_buffers: int = 0,
+    block_tables: jax.Array | None = None,
+    paged_impl: str = "gather",
 ) -> tuple[jax.Array, Cache]:
     """Run T tokens at positions pos..pos+T-1. Returns ([B, T, V] logits,
     updated cache). MoE configs route each token through the expert MLPs
     (no-drop capacity — see ``_moe_mlp``); routing is stateless, so the
     KV cache is untouched by the choice of MLP.
+
+    ``block_tables`` [B, n_pages] switches the cache to the PAGED pool
+    layout (``init_paged_cache``: [L, P, page, Hkv, D] leaves) with
+    per-row page indirection — the serving block-pool mode
+    (serving/engine.PagedBatchedDecodeEngine). ``pos`` must then be a
+    [B] vector. ``paged_impl`` picks the paged attention backend for
+    single-token steps ("gather" XLA fallback / "kernel" Pallas /
+    "kernel_interpret" for the CPU rig's kernel tests).
 
     ``pos`` may be a [B] VECTOR: each batch row then runs at its own
     position (cache write offset, attention mask, wpe/rope angles) — the
@@ -223,6 +307,11 @@ def forward(
     dtype = jnp.dtype(cfg.dtype)
     pos = jnp.asarray(pos, jnp.int32)
     per_row = pos.ndim > 0  # [B] vector: slot-batched, per-row positions
+    if block_tables is not None and not per_row:
+        raise ValueError(
+            "paged decode (block_tables) requires a per-row [B] pos "
+            "vector — every paged row owns its own position"
+        )
 
     if cfg.family == "gpt2":
         if per_row:
@@ -231,7 +320,10 @@ def forward(
         else:
             wpe = jax.lax.dynamic_slice_in_dim(params["wpe"], pos, t, axis=0)
         x = (params["wte"][input_ids] + wpe).astype(dtype)
-        block = partial(_gpt2_block, cfg=cfg, tensor_axis=tensor_axis)
+        block = partial(
+            _gpt2_block, cfg=cfg, tensor_axis=tensor_axis,
+            block_tables=block_tables, paged_impl=paged_impl,
+        )
     elif cfg.family == "llama":
         x = params["wte"][input_ids].astype(dtype)
         cos, sin = rope_angles(
@@ -241,6 +333,7 @@ def forward(
         block = partial(
             _llama_block, cfg=cfg, cos=cos, sin=sin,
             tensor_axis=tensor_axis,
+            block_tables=block_tables, paged_impl=paged_impl,
         )
     else:
         raise KeyError(f"unknown model family {cfg.family!r}")

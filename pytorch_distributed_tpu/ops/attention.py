@@ -11,6 +11,14 @@ Implementations:
   materialises the score matrix — O(T) memory.
 - ``ring``: sequence-parallel blockwise attention over a mesh axis
   (ops/ring_attention.py).
+- ``paged`` (decode only): single-query attention against a PAGED KV
+  pool addressed through per-row block tables — the serving block-pool
+  layout (serving/engine.PagedBatchedDecodeEngine). Not dispatched
+  through ``multi_head_attention`` (it is a decode-cache op, not a
+  training attention: one query token, keys gathered by page id);
+  re-exported here as ``paged_decode_attention`` so the attention
+  surface stays one module. Pallas kernel + XLA gather fallback live in
+  ops/paged_kernel.py.
 
 All variants support grouped-query attention (n_kv_head < n_head) for the
 llama family.
@@ -22,6 +30,19 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30  # finite mask value: -inf breaks softmax when a row is all-masked
+
+
+def paged_decode_attention(*args, **kwargs):
+    """Lazy re-export of ops/paged_kernel.paged_decode_attention (see
+    module docstring): paged single-query decode attention, [B, H, D]
+    queries against a [P, page, Hkv, D] pool via [B, n_pages] block
+    tables. Lazy so importing the training attention surface never pays
+    the Pallas import."""
+    from pytorch_distributed_tpu.ops.paged_kernel import (
+        paged_decode_attention as impl,
+    )
+
+    return impl(*args, **kwargs)
 
 
 def _repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:

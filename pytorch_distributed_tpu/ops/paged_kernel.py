@@ -1,0 +1,218 @@
+"""Pallas TPU paged-attention decode kernel (+ XLA gather fallback).
+
+Single-query attention for the paged serving engine
+(serving/engine.PagedBatchedDecodeEngine): each batch row's K/V lives in
+fixed-size PAGES of a shared pool ``[P, page, Hkv, D]``, addressed
+through a per-row block table — the vLLM cache layout, which is what
+lets ``slots`` scale with the pool instead of ``slots x max_len``
+(ROADMAP direction 1; serving practice surveyed in PAPERS.md #1).
+
+The kernel is the piece that makes per-row attention cost scale with the
+row's DEPTH instead of ``max_len``:
+
+- grid ``(B, Hkv, n_pages)`` with the page dimension innermost and
+  sequential (online-softmax accumulator state lives in VMEM scratch
+  across it);
+- the block tables and per-row lengths ride ``PrefetchScalarGridSpec``
+  scalar prefetch, so the K/V BlockSpec *index maps* resolve
+  ``tables[b, i]`` before the body runs — the page "gather" is just the
+  kernel's own DMA picking its source block, never a materialised
+  [B, max_len] copy;
+- pages past a row's depth are skipped with ``pl.when`` (no MXU work,
+  and their DMA re-reads the row's last useful page id — the host fills
+  unallocated table entries with the scratch page 0, so the skipped
+  fetch is bounded and harmless);
+- grouped-query heads share their KV head inside the kernel: the grid
+  walks KV heads and each step computes the whole ``group = H // Hkv``
+  query-head block against one [page, D] key block.
+
+GQA + per-row depth masking match ``models/decode._cached_attention``'s
+masked-softmax math up to online-softmax reassociation (floating-point
+reordering only — the equivalence test pins allclose, and engine-level
+token equality is pinned separately on the gather path).
+
+Off-TPU (this repo's CPU rig) the kernel runs in INTERPRET mode — the
+dispatcher defaults to it automatically — and the serving engine's
+default paged attention is the pure-XLA ``gather_pages`` fallback in
+models/decode.py, which is bit-identical to the dense engine's math (the
+property the paged-vs-dense token-equality pins rely on). Read
+/opt/skills/guides/pallas_guide.md before touching the kernel body.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pytorch_distributed_tpu.ops.flash_kernel import _compiler_params
+
+NEG_INF = -1e30  # finite mask (matches ops/attention.py): -inf NaNs softmax
+
+
+def _paged_kernel(
+    tables_ref,  # [B, n_pages] int32 (scalar prefetch)
+    lens_ref,  # [B] int32 (scalar prefetch): row's query position
+    q_ref,  # [1, group, D]
+    k_ref,  # [1, page, 1, D] — the page tables_ref[b, i], head h
+    v_ref,  # [1, page, 1, D]
+    o_ref,  # [1, group, D]
+    acc_sc,  # [group, D] f32
+    m_sc,  # [group, 1] f32
+    l_sc,  # [group, 1] f32
+    *,
+    page: int,
+    n_pages: int,
+    scale: float,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc[:])
+        m_sc[:] = jnp.full_like(m_sc[:], NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc[:])
+
+    length = lens_ref[b]  # keys 0..length (inclusive) are valid
+
+    # Pages wholly past the row's depth do no work: the decode cost of a
+    # short row is its own page count, not max_len.
+    @pl.when(i * page <= length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [group, D]
+        kb = k_ref[0, :, 0, :].astype(jnp.float32)  # [page, D]
+        vb = v_ref[0, :, 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [group, page]
+        kpos = i * page + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(kpos <= length, s, NEG_INF)
+        m_new = jnp.maximum(m_sc[:], jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_sc[:] - m_new)
+        l_sc[:] = l_sc[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[:] = m_new
+
+    @pl.when(i == n_pages - 1)
+    def _emit():
+        o_ref[0] = (
+            acc_sc[:] / jnp.maximum(l_sc[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+# repolint: allow(jit-donation-decision) — functional attention op: the
+# K/V pages belong to the serving engine's donated cache (aliased at the
+# PROGRAM boundary, not here) and q is read by the caller's residual.
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_call(q, k_pages, v_pages, block_tables, lengths, interpret):
+    b, h, d = q.shape
+    n_pages = block_tables.shape[1]
+    page, hkv = k_pages.shape[1], k_pages.shape[2]
+    group = h // hkv
+    kernel = functools.partial(
+        _paged_kernel,
+        page=page, n_pages=n_pages, scale=1.0 / (d**0.5),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, n_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (1, group, d), lambda bi, hi, i, tables, lens: (bi, hi, 0)
+            ),
+            pl.BlockSpec(
+                (1, page, 1, d),
+                lambda bi, hi, i, tables, lens: (tables[bi, i], 0, hi, 0),
+            ),
+            pl.BlockSpec(
+                (1, page, 1, d),
+                lambda bi, hi, i, tables, lens: (tables[bi, i], 0, hi, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, group, d), lambda bi, hi, i, tables, lens: (bi, hi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+        **_compiler_params(),
+    )(block_tables, lengths, q, k_pages, v_pages)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, H, D] — ONE query token per row
+    k_pages: jax.Array,  # [P, page, Hkv, D]
+    v_pages: jax.Array,  # [P, page, Hkv, D]
+    block_tables: jax.Array,  # [B, n_pages] int32 page ids
+    lengths: jax.Array,  # [B] int32: the row's position (keys <= it valid)
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Paged single-query attention, [B, H, D] -> [B, H, D]. ``lengths``
+    is each row's query position: key j is attended iff j <= lengths[b]
+    (the dense decode-step mask at T=1). ``interpret=None`` picks the
+    compiled kernel on TPU and interpreter mode elsewhere."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    h, hkv = q.shape[1], k_pages.shape[2]
+    if h % hkv:
+        raise ValueError(
+            f"query heads {h} must be a multiple of kv heads {hkv}"
+        )
+    return _paged_call(
+        q, k_pages, v_pages,
+        jnp.asarray(block_tables, jnp.int32),
+        jnp.asarray(lengths, jnp.int32),
+        bool(interpret),
+    )
+
+
+def paged_decode_attention_reference(
+    q, k_pages, v_pages, block_tables, lengths
+) -> jax.Array:
+    """Pure-XLA reference: gather the per-row page view and run the
+    dense masked-softmax math (models/decode._cached_attention's paged
+    gather branch, restated at the T=1 shape) — what the kernel is
+    equivalence-tested against."""
+    from pytorch_distributed_tpu.models.decode import gather_pages
+
+    b, h, d = q.shape
+    ck = gather_pages(k_pages, jnp.asarray(block_tables, jnp.int32))
+    cv = gather_pages(v_pages, jnp.asarray(block_tables, jnp.int32))
+    s = ck.shape[1]
+    hkv = ck.shape[2]
+    if hkv != h:
+        rep = h // hkv
+        ck = jnp.repeat(ck, rep, axis=2)
+        cv = jnp.repeat(cv, rep, axis=2)
+    scores = jnp.einsum(
+        "bhd,bshd->bhs", q, ck, preferred_element_type=jnp.float32
+    ) / (d**0.5)
+    kpos = jnp.arange(s, dtype=jnp.int32)
+    valid = kpos[None, None, :] <= jnp.asarray(lengths, jnp.int32)[
+        :, None, None
+    ]
+    scores = jnp.where(valid, scores, NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhs,bshd->bhd", w.astype(cv.dtype), cv
+    ).astype(q.dtype)

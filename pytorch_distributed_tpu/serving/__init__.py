@@ -9,10 +9,14 @@ from pytorch_distributed_tpu.serving.chaos import (  # noqa: F401
     FaultInjector,
     VirtualClock,
 )
+from pytorch_distributed_tpu.serving.block_pool import (  # noqa: F401
+    BlockPool,
+)
 from pytorch_distributed_tpu.serving.engine import (  # noqa: F401
     BatchedDecodeEngine,
     BucketSpec,
     DecodeEngine,
+    PagedBatchedDecodeEngine,
     shim_engine,
 )
 from pytorch_distributed_tpu.serving.lifecycle import (  # noqa: F401
@@ -24,6 +28,7 @@ from pytorch_distributed_tpu.serving.lifecycle import (  # noqa: F401
     AdmissionQueueFull,
     DispatchFailure,
     EngineSnapshot,
+    PagePoolExhausted,
     RequestFailed,
     RequestResult,
 )

@@ -1181,6 +1181,7 @@ class BatchedDecodeEngine:
         for i, s in enumerate(self._slots):
             if s is not None and s.rid == rid:
                 self._slots[i] = None
+                self._on_slot_freed(s)
                 self._finish_slot(s, ABORTED, "abort() mid-decode")
                 return True
         if rid in self.results:
@@ -1473,6 +1474,7 @@ class BatchedDecodeEngine:
         for i, s in enumerate(self._slots):
             if s is not None and s.deadline is not None and now >= s.deadline:
                 self._slots[i] = None
+                self._on_slot_freed(s)
                 self._finish_slot(
                     s, EXPIRED,
                     f"deadline passed at t={now:.3f} mid-decode", finished,
@@ -1592,6 +1594,7 @@ class BatchedDecodeEngine:
                 continue
             if bad[i]:
                 self._slots[i] = None
+                self._on_slot_freed(s)
                 self._quarantine_slot(s, i, finished)
                 continue
             s.generated.append(int(out[i]))
@@ -1619,21 +1622,24 @@ class BatchedDecodeEngine:
             req, gen=list(req.gen), nan_retried=True
         )])
 
-    def _quarantine_slot(self, s: _Slot, row: int, finished) -> None:
-        """Non-finite logits on an active row mid-decode: free the row
-        (neighbours untouched — per-row masking means its re-prefill
-        reads only what it rewrites), requeue its CLEAN prefix for one
-        fresh re-prefill, then FAILED on recurrence."""
+    def _quarantine_slot(self, s: _Slot, row: int, finished,
+                         phase: str = "decode") -> None:
+        """Non-finite logits on an active row: free the row (neighbours
+        untouched — per-row masking means its re-prefill reads only what
+        it rewrites), requeue its CLEAN prefix for one fresh re-prefill,
+        then FAILED on recurrence. ``phase`` labels the lifecycle log
+        and failure reason (the paged engine's chunked prefill
+        quarantines through here too)."""
         self.stats["nan_quarantines"] += 1
         if s.nan_retried:
             self._finish_slot(
                 s, FAILED,
                 "non-finite logits persisted after one quarantine retry "
-                "(decode)", finished,
+                f"({phase})", finished,
             )
             return
         log_event(
-            "quarantine", rid=s.rid, phase="decode", row=row,
+            "quarantine", rid=s.rid, phase=phase, row=row,
             t=round(self._clock(), 6),
         )
         self._requeue([
@@ -1683,10 +1689,11 @@ class BatchedDecodeEngine:
             streak=self._fail_streak, error=type(err).__name__,
             t=round(self._clock(), 6),
         )
-        lost = [
-            self._pending_from_slot(s, bump=True)
-            for s in self._slots if s is not None
-        ]
+        lost = []
+        for s in self._slots:
+            if s is not None:
+                lost.append(self._pending_from_slot(s, bump=True))
+                self._on_slot_freed(s)
         self._slots = [None] * self.slots
         lost += [
             dataclasses.replace(q, gen=list(q.gen), retries=q.retries + 1)
@@ -1729,7 +1736,15 @@ class BatchedDecodeEngine:
         # Retirement is pure host bookkeeping: the row's K/V stays in
         # place (dirty) and the next admission masks it out.
         self._slots[row] = None
+        self._on_slot_freed(s)
         self._finish_slot(s, DONE, "", finished)
+
+    def _on_slot_freed(self, s: _Slot) -> None:
+        """Hook: called whenever an occupied slot leaves the slot list
+        (retire / abort / expire / quarantine / dispatch-failure
+        conversion). The dense engine has nothing to do — a freed row's
+        K/V just sits dirty in its own row; the paged subclass releases
+        the row's page references here."""
 
     # -- introspection -----------------------------------------------------
 
@@ -1739,6 +1754,22 @@ class BatchedDecodeEngine:
         churn tests assert this stays flat across admissions and
         retirements at a fixed slot count."""
         return sum(p._cache_size() for p in self._programs.values())
+
+    def _bytes_per_position(self) -> int:
+        """K+V bytes one GLOBAL cache position costs across all layers
+        (TP divides the positions' head dim across shards, so the global
+        figure is the comparable one either way)."""
+        cfg = self.cfg
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        return cfg.n_layer * 2 * cfg.kv_heads * cfg.head_dim * itemsize
+
+    def cache_hbm_bytes(self) -> dict[str, int]:
+        """Allocated KV-cache HBM (the dense engine preallocates
+        slots x max_len positions whether rows are deep or not — the
+        number the paged engine's pool is benched against)."""
+        n = self.slots * self.max_len
+        b = n * self._bytes_per_position()
+        return {"allocated": b, "peak_in_use": b}
 
     def example_args(self, kind: str, params, *, bucket: int | None = None,
                      group: int = 1, cache: decode.Cache | None = None):
@@ -1805,6 +1836,607 @@ class BatchedDecodeEngine:
                     f"compiled executable — {findings[0].message}"
                 )
         return stats_all
+
+
+@dataclasses.dataclass
+class _PagedSlot(_Slot):
+    """One occupied row of the PAGED slot batch. Extends ``_Slot`` with
+    the row's page bookkeeping and chunked-prefill progress: ``pos``
+    doubles as the prefill cursor (next position to prefill) until it
+    reaches ``prefill_len``, after which the row is decode-ready and
+    ``pos`` means what it means on the dense engine (next KV write
+    offset). Dataclass-inheritance ordering forces defaults here; the
+    engine always fills them at admission."""
+
+    prefix: np.ndarray | None = None  # prompt + resume tokens to prefill
+    prefill_len: int = 0  # len(prefix)
+    table: np.ndarray | None = None  # [max_pages] int32 page ids (0=scratch)
+    pids: list = dataclasses.field(default_factory=list)  # pages held
+    n_pages: int = 0  # allocated table entries
+    prefill_keydata: np.ndarray | None = None  # key for the final chunk draw
+    resume_base: int = 0  # len(resume gen) riding ahead of fresh tokens
+    chain_key: str = ""  # prefix-cache chain key at pos (1 digest/publish)
+
+    @property
+    def ready(self) -> bool:
+        return self.pos >= self.prefill_len
+
+
+class PagedBatchedDecodeEngine(BatchedDecodeEngine):
+    """Continuous batching over a PAGED KV cache: the block-pool refactor
+    of ``BatchedDecodeEngine`` (ROADMAP direction 1 — the vLLM move).
+
+    The dense engine's ``(slots, max_len)`` cache charges every row
+    O(max_len) HBM and O(max_len) attention regardless of its depth.
+    Here the cache is a flat pool of fixed-size PAGES —
+    ``[L, pool_pages, page_size, Hkv, D]`` — and each row holds a BLOCK
+    TABLE of page ids instead of a dedicated row. Three consequences,
+    all machine-checked:
+
+    - **HBM scales with the pool, not slots x max_len**: ``slots`` can
+      exceed what uniform-max_len rows would fit, because real rows are
+      rarely max_len deep. Pool exhaustion mid-decode PREEMPTS the
+      youngest active request (clean resume entry, re-admitted when
+      pages free — "queued last, preempted first"), so overcommit
+      degrades to queueing, never to a hang or corruption; admission
+      additionally defers when the pool cannot cover a prompt.
+    - **Prefix sharing**: identical prompt prefixes are stored ONCE
+      (serving/block_pool.py: chunk-chained sha1 keys, refcounted pages,
+      LRU retention after the last reference drops), copy-on-write by
+      construction — shared pages are never written, forks land on
+      private pages. Hit counts ride the lifecycle log and
+      ``pool.stats``.
+    - **Chunked prefill**: an admission is fed through the tick in
+      ``prefill_chunk``-token chunks (one chunk per row per tick), so a
+      long prompt never stalls in-flight rows for its whole prefill —
+      the per-tick prefill cost is bounded by chunk x group, and decode
+      ticks interleave. The chunk is the prefill compile shape (no
+      prompt buckets: compile set = groups x ONE chunk shape + one
+      decode step).
+
+    Everything traced stays fixed-shape: block tables are [slots,
+    max_pages] int32 OPERANDS (values change per tick, shapes never), so
+    the PR-5 zero-steady-state-compile contract and the PR-6 fault
+    model (quarantine, dispatch recovery, snapshot/replay) carry over
+    unchanged — a failed dispatch consumed the donated POOL, so recovery
+    additionally resets the block pool and prefix cache (the content the
+    cache keys pointed at is gone). Attention defaults to the pure-XLA
+    ``gather_pages`` fallback (bit-identical math to the dense engine —
+    the paged-vs-dense token-equality pins in
+    tests/test_serving_paged.py rely on it); on TPU,
+    ``paged_attention="kernel"`` dispatches the Pallas paged-attention
+    decode kernel (ops/paged_kernel.py), whose per-row cost scales with
+    the row's page count.
+
+    Knobs: ``page_size`` (tokens per KV page; must divide ``max_len``),
+    ``pool_pages`` (pool capacity incl. the reserved scratch page 0;
+    default = dense-equivalent ``slots * max_len/page_size + 1``),
+    ``prefill_chunk`` (chunked-prefill quantum; page-multiple dividing
+    ``max_len``, default = largest such <= 64).
+    """
+
+    CACHE_ARGNUM = {"prefill": 5, "decode_step": 2}
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        *,
+        slots: int,
+        max_len: int,
+        page_size: int = 16,
+        pool_pages: int | None = None,
+        prefill_chunk: int | None = None,
+        paged_attention: str = "gather",
+        mesh_cfg: MeshConfig | None = None,
+        **kw,
+    ) -> None:
+        if page_size < 1 or max_len % page_size:
+            raise ValueError(
+                f"page_size ({page_size}) must be a positive divisor of "
+                f"max_len ({max_len}): the block table addresses exactly "
+                "max_len/page_size pages per row, and a ragged final "
+                "page would silently truncate the last "
+                f"{max_len % page_size if page_size >= 1 else 0} cache "
+                "positions — pick page_size from the divisors of max_len"
+            )
+        super().__init__(
+            cfg, slots=slots, max_len=max_len, buckets=None,
+            mesh_cfg=mesh_cfg, **kw,
+        )
+        self.page_size = int(page_size)
+        self.max_pages = max_len // page_size
+        if prefill_chunk is None:
+            # Largest page-multiple <= 64 that divides max_len. The
+            # chunk is BOTH the prefill quantum (per-tick prefill work
+            # is bounded by chunk x group) and the prefix-sharing
+            # granularity (block_pool caches chunk-chained prefixes), so
+            # the default leans small; deployments with long shared
+            # system prompts and long arrivals tune it per traffic.
+            prefill_chunk = page_size
+            while (
+                prefill_chunk * 2 <= min(64, max_len)
+                and max_len % (prefill_chunk * 2) == 0
+            ):
+                prefill_chunk *= 2
+        if (
+            prefill_chunk < page_size
+            or prefill_chunk % page_size
+            or max_len % prefill_chunk
+        ):
+            raise ValueError(
+                f"prefill_chunk ({prefill_chunk}) must be a multiple of "
+                f"page_size ({page_size}) that divides max_len "
+                f"({max_len}) — chunk starts are page-aligned and the "
+                "padded final chunk must stay inside the row's table"
+            )
+        self.chunk = int(prefill_chunk)
+        if pool_pages is None:
+            pool_pages = slots * self.max_pages + 1
+        if pool_pages < self.max_pages + 1:
+            raise ValueError(
+                f"pool_pages ({pool_pages}) must be >= max_len/page_size "
+                f"+ 1 = {self.max_pages + 1} (one full-length row plus "
+                "the scratch page), or a single deep request could "
+                "never be served"
+            )
+        self.pool_pages = int(pool_pages)
+        from pytorch_distributed_tpu.serving.block_pool import BlockPool
+
+        self.pool = BlockPool(self.pool_pages, self.page_size, self.chunk)
+        if paged_attention == "auto":
+            paged_attention = (
+                "kernel" if jax.devices()[0].platform == "tpu"
+                else "gather"
+            )
+        if paged_attention not in ("gather", "kernel", "kernel_interpret"):
+            raise ValueError(
+                f"paged_attention must be 'auto', 'gather', 'kernel' or "
+                f"'kernel_interpret', got {paged_attention!r}"
+            )
+        self._paged_impl = paged_attention
+        self.stats["preemptions"] = 0
+
+    # -- cache -------------------------------------------------------------
+
+    def _new_cache(self) -> decode.Cache:
+        self.stats["cache_allocs"] += 1
+        if self.mode == "tp":
+            full = decode.init_paged_cache(
+                self.cfg, self.pool_pages, self.page_size
+            )
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = P(None, None, None, "tensor", None)
+            sharding = jax.tree.map(
+                lambda s: NamedSharding(self._mesh, s),
+                {"k": spec, "v": spec},
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            return jax.device_put(full, sharding)
+        return decode.init_paged_cache(
+            self.cfg, self.pool_pages, self.page_size, n_kv=self._n_kv
+        )
+
+    def cache_hbm_bytes(self) -> dict[str, int]:
+        """Allocated pool HBM + the peak actually referenced by live
+        rows (pages_in_use x page_size positions) — the numbers
+        ``decode_bench --serving-paged`` reports against the dense
+        engine's slots x max_len."""
+        per = self._bytes_per_position()
+        return {
+            "allocated": self.pool_pages * self.page_size * per,
+            "peak_in_use": (
+                self.pool.stats["peak_pages_in_use"] * self.page_size * per
+            ),
+        }
+
+    # -- programs ----------------------------------------------------------
+
+    def _forward_paged(self, params, ids, cache, pos, tables):
+        kwargs = {"block_tables": tables, "paged_impl": self._paged_impl}
+        if self.mode == "tp":
+            kwargs["tensor_axis"] = "tensor"
+        return decode.forward(params, ids, self.cfg, cache, pos, **kwargs)
+
+    def _bodies(self):
+        """The two paged program bodies. Same traced-everything
+        discipline as the dense engine, plus the [B, max_pages] block
+        tables as int32 operands; the NaN sentinel and sampling are
+        shared with the dense bodies so they can never drift."""
+
+        def prefill(params, chunks, valid, start, tables, cache,
+                    greedy, t, k, p, keydata):
+            # One CHUNK per row: tokens chunks[:, :valid] run at
+            # positions start..start+valid-1 (pad positions write
+            # garbage past the write point into the row's own padded
+            # extent — the dense dirty-cache discipline at page
+            # granularity). The sampled token only matters for rows on
+            # their final chunk; the host discards the rest.
+            logits, cache = self._forward_paged(
+                params, chunks, cache, start, tables
+            )
+            last = jnp.take_along_axis(
+                logits, (valid - 1)[:, None, None], axis=1
+            )[:, 0]
+            keys = jax.random.wrap_key_data(keydata)
+            tok = decode.sample_token_rows(last, greedy, t, keys, k, p)
+            return tok, decode.nonfinite_rows(last), cache
+
+        def decode_step(params, toks, cache, pos, tables, folds,
+                        greedy, t, k, p, keydata):
+            logits, cache = self._forward_paged(
+                params, toks[:, None], cache, pos, tables
+            )
+            last = logits[:, -1]
+            keys = jax.vmap(jax.random.fold_in)(
+                jax.random.wrap_key_data(keydata), folds
+            )
+            tok = decode.sample_token_rows(last, greedy, t, keys, k, p)
+            return tok, decode.nonfinite_rows(last), cache
+
+        return {"prefill": prefill, "decode_step": decode_step}
+
+    def program(self, kind: str):
+        if kind not in _BATCHED_PROGRAM_KINDS:
+            raise KeyError(f"unknown batched program kind {kind!r}")
+        prog = self._programs.get(kind)
+        if prog is not None:
+            return prog
+        body = self._bodies()[kind]
+        donate = (self.CACHE_ARGNUM[kind],)
+        if self.mode == "plain":
+            prog = jax.jit(body, donate_argnums=donate)
+        else:  # tp: head-sharded page pool, everything else replicated
+            from jax.sharding import PartitionSpec as P
+
+            from pytorch_distributed_tpu.utils.compat import shard_map
+
+            cache_spec = {
+                "k": P(None, None, None, "tensor", None),
+                "v": P(None, None, None, "tensor", None),
+            }
+            specs = {
+                "prefill": (
+                    self._p_specs, P(), P(), P(), P(), cache_spec,
+                    P(), P(), P(), P(), P(),
+                ),
+                "decode_step": (
+                    self._p_specs, P(), cache_spec, P(), P(), P(),
+                    P(), P(), P(), P(), P(),
+                ),
+            }[kind]
+            smapped = shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=specs,
+                out_specs=(P(), P(), cache_spec),
+                check_vma=True,
+            )
+            prog = jax.jit(smapped, donate_argnums=donate)
+        self._programs[kind] = prog
+        return prog
+
+    # -- scheduler ---------------------------------------------------------
+
+    def _admit(self, params, finished: list[int]) -> None:
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        while free and self._queue:
+            slot = self._try_allocate(self._queue[0])
+            if slot is None:
+                # Head-of-line waits for pages (FIFO stays FIFO); decode
+                # keeps running, retirements free pages — deferral, not
+                # a hang.
+                break
+            self._queue.popleft()
+            row = free.pop(0)
+            self._slots[row] = slot
+            log_event(
+                "admit", rid=slot.rid, row=row,
+                cached_tokens=slot.pos or None,
+                resume_prefix=slot.resume_base or None,
+                t=round(self._clock(), 6),
+            )
+        self._chunk_prefill_tick(params, finished)
+
+    def _try_allocate(self, req: _Pending) -> _PagedSlot | None:
+        """Build a slot for ``req`` if the pool can cover its prefill
+        extent: shared prefix pages are acquired from the prefix cache
+        (never for a quarantine retry — a poisoned row re-prefills from
+        scratch on purpose), private pages allocated for the rest,
+        rounded up to the chunk the padded final prefill writes."""
+        prefix = self._partial_tokens(req.prompt, req.gen)
+        plen = prefix.shape[0]
+        if req.nan_retried:
+            cached, shared, chain_key = 0, [], ""
+        else:
+            cached, shared, chain_key = self.pool.match_prefix(
+                prefix, plen - 1
+            )
+        ext = -(-plen // self.chunk) * self.chunk  # padded prefill extent
+        fresh = self.pool.alloc(ext // self.page_size - len(shared))
+        if fresh is None:
+            # Deferred, not admitted: the match never happened as far as
+            # the hit counters are concerned — a head-of-line request
+            # retrying every tick must not inflate the committed stats.
+            # (A quarantine retry never queried, so nothing to cancel.)
+            if not req.nan_retried:
+                self.pool.cancel_match(cached, shared)
+            return None
+        if cached:
+            log_event(
+                "prefix_hit", rid=req.rid, cached_tokens=cached,
+                prompt_len=plen, t=round(self._clock(), 6),
+            )
+        pids = list(shared) + fresh
+        table = np.zeros((self.max_pages,), np.int32)
+        table[: len(pids)] = pids
+        return _PagedSlot(
+            rid=req.rid, prompt=req.prompt, max_new=req.max_new,
+            eos_id=req.eos_id, pos=cached, fold=len(req.gen),
+            generated=list(req.gen), greedy=req.greedy,
+            t=req.t, k=req.k, p=req.p, keydata=req.keydata,
+            deadline=req.deadline, retries=req.retries,
+            nan_retried=req.nan_retried,
+            prefix=prefix, prefill_len=plen, table=table, pids=pids,
+            n_pages=len(pids), prefill_keydata=req.prefill_keydata,
+            resume_base=len(req.gen), chain_key=chain_key,
+        )
+
+    def _chunk_prefill_tick(self, params, finished: list[int]) -> None:
+        """Advance every mid-prefill row by ONE chunk (one grouped
+        dispatch): long prompts trickle in across ticks while decode-
+        ready neighbours keep generating — the chunked-prefill
+        contract."""
+        rows = [
+            (i, s) for i, s in enumerate(self._slots)
+            if s is not None and not s.ready
+        ]
+        if not rows:
+            return
+        n = len(rows)
+        npad = next(g for g in self._groups if g >= n)
+        idx = list(range(n)) + [0] * (npad - n)
+        chunks = np.zeros((npad, self.chunk), np.int32)
+        valid = np.ones((npad,), np.int32)
+        start = np.zeros((npad,), np.int32)
+        tables = np.zeros((npad, self.max_pages), np.int32)
+        greedy = np.zeros((npad,), np.bool_)
+        t = np.ones((npad,), np.float32)
+        k = np.full((npad,), self.cfg.vocab_size, np.int32)
+        p = np.full((npad,), 2.0, np.float32)
+        keydata = np.zeros((npad, self._key_words), np.uint32)
+        for j, ii in enumerate(idx):
+            _, s = rows[ii]
+            v = min(self.chunk, s.prefill_len - s.pos)
+            chunks[j, :v] = s.prefix[s.pos : s.pos + v]
+            valid[j] = v
+            start[j] = s.pos
+            tables[j] = s.table
+            greedy[j] = s.greedy
+            t[j], k[j], p[j] = s.t, s.k, s.p
+            keydata[j] = s.prefill_keydata
+        res = self._dispatch(
+            "prefill", params, [], finished,
+            jnp.asarray(chunks), jnp.asarray(valid), jnp.asarray(start),
+            jnp.asarray(tables), None, jnp.asarray(greedy),
+            jnp.asarray(t), jnp.asarray(k), jnp.asarray(p),
+            jnp.asarray(keydata),
+        )
+        if res is None:
+            return  # recovery converted every in-flight row already
+        toks, bad = res
+        for j in range(n):
+            row, s = rows[j]
+            if bad[j]:
+                self._slots[row] = None
+                self._on_slot_freed(s)
+                self._quarantine_slot(s, row, finished, phase="prefill")
+                continue
+            v = min(self.chunk, s.prefill_len - s.pos)
+            if v == self.chunk:
+                # A full chunk lies entirely inside the prefix: publish
+                # its pages for prefix sharing (clean chunks only — a
+                # flagged row never contaminates the cache). The chain
+                # key rides the slot, so each publish is one digest.
+                cp = self.chunk // self.page_size
+                first = s.pos // self.page_size
+                s.chain_key = self.pool.register_chunk(
+                    s.prefix, s.pos,
+                    s.table[first : first + cp].tolist(),
+                    prev_key=s.chain_key,
+                )
+            s.pos += v
+            if s.pos >= s.prefill_len:
+                s.generated.append(int(toks[j]))
+                self._maybe_retire(row, finished)
+
+    def _decode_tick(self, params, finished: list[int]) -> None:
+        self._ensure_decode_pages(finished)
+        ready = [
+            (i, s) for i, s in enumerate(self._slots)
+            if s is not None and s.ready
+        ]
+        if not ready:
+            return
+        b = self.slots
+        toks = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        tables = np.zeros((b, self.max_pages), np.int32)
+        folds = np.zeros((b,), np.int32)
+        greedy = np.ones((b,), np.bool_)
+        t = np.ones((b,), np.float32)
+        k = np.full((b,), self.cfg.vocab_size, np.int32)
+        p = np.full((b,), 2.0, np.float32)
+        keydata = np.zeros((b, self._key_words), np.uint32)
+        for i, s in ready:
+            # Free AND mid-prefill rows stay all-zero: table 0 -> the
+            # scratch page, so their garbage write/read never touches a
+            # live row's pages.
+            toks[i] = s.generated[-1]
+            pos[i] = s.pos
+            tables[i] = s.table
+            folds[i] = s.fold
+            greedy[i] = s.greedy
+            t[i], k[i], p[i] = s.t, s.k, s.p
+            keydata[i] = s.keydata
+        res = self._dispatch(
+            "decode_step", params, None, finished, jnp.asarray(toks),
+            None, jnp.asarray(pos), jnp.asarray(tables),
+            jnp.asarray(folds), jnp.asarray(greedy), jnp.asarray(t),
+            jnp.asarray(k), jnp.asarray(p), jnp.asarray(keydata),
+        )
+        if res is None:
+            return
+        out, bad = res
+        for i, s in enumerate(self._slots):
+            if s is None or not s.ready:
+                continue
+            if bad[i]:
+                self._slots[i] = None
+                self._on_slot_freed(s)
+                self._quarantine_slot(s, i, finished)
+                continue
+            s.generated.append(int(out[i]))
+            s.pos += 1
+            s.fold += 1
+            self._maybe_retire(i, finished)
+
+    def _ensure_decode_pages(self, finished: list[int]) -> None:
+        """Grow each decode-ready row's table to cover its next write.
+        Pool exhaustion preempts the YOUNGEST other active request
+        (admitted last -> preempted first): its clean prefix requeues as
+        a resume entry — no retry charge, no token loss — and its pages
+        come back to the pool."""
+        for i in range(self.slots):
+            # Read the LIVE slot list each iteration: a preemption fired
+            # for an earlier row may have freed this one, and growing a
+            # dead slot would leak its page (and could preempt a live
+            # row to feed a corpse).
+            s = self._slots[i]
+            if s is None or not s.ready:
+                continue
+            if s.pos // self.page_size < s.n_pages:
+                continue
+            while True:
+                got = self.pool.alloc(1)
+                if got is not None:
+                    s.table[s.n_pages] = got[0]
+                    s.pids += got
+                    s.n_pages += 1
+                    break
+                if not self._preempt_one(exclude_rid=s.rid, finished=finished):
+                    from pytorch_distributed_tpu.serving.lifecycle import (
+                        PagePoolExhausted,
+                    )
+
+                    raise PagePoolExhausted(
+                        f"no KV page available for rid {s.rid} at depth "
+                        f"{s.pos} and nothing left to preempt — "
+                        f"pool_pages={self.pool_pages} cannot hold one "
+                        "row this deep (construction should have "
+                        "rejected this configuration)"
+                    )
+
+    def _preempt_one(self, *, exclude_rid: int, finished) -> bool:
+        cands = [
+            (s.rid, i) for i, s in enumerate(self._slots)
+            if s is not None and s.rid != exclude_rid
+        ]
+        if not cands:
+            return False
+        rid, row = max(cands)  # youngest = submitted last
+        s = self._slots[row]
+        self._slots[row] = None
+        self._on_slot_freed(s)
+        self.stats["preemptions"] += 1
+        log_event(
+            "preempt", rid=rid, row=row, depth=s.pos,
+            generated=len(s.generated) - s.resume_base,
+            t=round(self._clock(), 6),
+        )
+        self._requeue([self._pending_from_slot(s, bump=False)])
+        return True
+
+    def _on_slot_freed(self, s: _Slot) -> None:
+        self.pool.release(s.pids)
+        s.pids = []
+
+    def _recover_dispatch_failure(self, kind, err, group_pendings,
+                                  finished) -> None:
+        # The donated page pool was consumed with the dispatch: its
+        # content is gone, so every cached prefix chunk would alias
+        # garbage. Reset the pool BEFORE base recovery (which may raise
+        # DispatchFailure at the end) and zero the slots' page lists so
+        # the freed-slot hook has nothing stale to release.
+        for s in self._slots:
+            if s is not None:
+                s.pids = []
+        self.pool.reset()
+        super()._recover_dispatch_failure(
+            kind, err, group_pendings, finished
+        )
+
+    # -- introspection / warmup --------------------------------------------
+
+    def warmup(self, params) -> int:
+        """Compile every prefill group shape plus the decode step (the
+        whole steady-state compile set: chunked prefill has ONE token
+        shape, so there is no bucket dimension to cover)."""
+        if self.has_work():
+            raise RuntimeError("warmup requires an idle engine")
+        params = self._place_params(params)
+        for g in self._groups:
+            args = self.example_args(
+                "prefill", params, group=g, cache=self._take_cache()
+            )
+            _, _, cache = self.program("prefill")(*args)
+            self._cache = cache
+        args = self.example_args(
+            "decode_step", params, cache=self._take_cache()
+        )
+        _, _, cache = self.program("decode_step")(*args)
+        self._cache = cache
+        return self.compile_count()
+
+    def example_args(self, kind: str, params, *, bucket: int | None = None,
+                     group: int = 1, cache: decode.Cache | None = None):
+        """Example argument tuple for lowering/auditing ``kind``.
+        ``bucket`` is accepted for API parity with the dense engine and
+        ignored — the chunk is the only prefill token shape."""
+        if cache is None:
+            cache = self._new_cache()
+        mp = self.max_pages
+        if kind == "prefill":
+            npad = next(g for g in self._groups if g >= group)
+            return (
+                params,
+                jnp.zeros((npad, self.chunk), jnp.int32),
+                jnp.ones((npad,), jnp.int32),
+                jnp.zeros((npad,), jnp.int32),
+                jnp.zeros((npad, mp), jnp.int32),
+                cache,
+                jnp.ones((npad,), jnp.bool_),
+                jnp.ones((npad,), jnp.float32),
+                jnp.full((npad,), self.cfg.vocab_size, jnp.int32),
+                jnp.full((npad,), 2.0, jnp.float32),
+                jnp.zeros((npad, self._key_words), jnp.uint32),
+            )
+        if kind == "decode_step":
+            b = self.slots
+            return (
+                params,
+                jnp.zeros((b,), jnp.int32),
+                cache,
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, mp), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.ones((b,), jnp.bool_),
+                jnp.ones((b,), jnp.float32),
+                jnp.full((b,), self.cfg.vocab_size, jnp.int32),
+                jnp.full((b,), 2.0, jnp.float32),
+                jnp.zeros((b, self._key_words), jnp.uint32),
+            )
+        raise KeyError(f"unknown batched program kind {kind!r}")
 
 
 @functools.lru_cache(maxsize=None)

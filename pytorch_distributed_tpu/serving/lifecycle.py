@@ -26,6 +26,17 @@ Non-terminal states (QUEUED/ACTIVE) are engine-internal — observable via
 ACTIVE -> QUEUED any number of times through the fault-resume path; the
 invariant the soak asserts is that every rid reaches exactly ONE terminal
 result, and a terminal rid never reappears.
+
+The paged engine (``PagedBatchedDecodeEngine``) adds one more
+ACTIVE -> QUEUED bounce: PREEMPTION. When the KV page pool is exhausted
+mid-decode, the youngest active request (the one "queued last") is
+converted to a resume entry — clean tokens-so-far preserved, pages
+released — and re-admitted when pages free up, continuing
+token-identically. Preemption is load shedding, not a fault: it charges
+no retry budget and cannot FAIL a request. The lifecycle log records it
+as a ``preempt`` event next to ``submit``/``admit``/``retire``, and
+paged admissions log their prefix-cache outcome (``prefix_hit`` with the
+shared token count) so cache effectiveness is visible per request.
 """
 
 from __future__ import annotations
@@ -89,6 +100,14 @@ class RequestFailed(RuntimeError):
     """The serial ``DecodeEngine`` detected non-finite logits and the one
     fresh-cache retry reproduced them — the request's output would be
     garbage, so it fails loudly instead of emitting tokens."""
+
+
+class PagePoolExhausted(RuntimeError):
+    """The paged engine could not free a KV page even after preempting
+    every other active request — an invariant violation (construction
+    validates ``pool_pages >= max_len/page_size + 1``, which guarantees
+    one full-length row always fits), kept as a loud defensive raise
+    instead of the silent hang a starved allocator would otherwise be."""
 
 
 class DispatchFailure(RuntimeError):

@@ -26,6 +26,18 @@ per-request p50/p99 latency derived from the SAME per-request completion
 timestamps, and the steady-state compile count of each leg (expected 0).
 Artifact: benchmarks/serving_batched_bench.json.
 
+``--serving-paged`` benchmarks the PAGED KV cache
+(serving/engine.PagedBatchedDecodeEngine — block-pool pages, prefix
+sharing, chunked prefill) against the dense PR-5 engine on one seeded
+arrival stream whose prompts repeat a shared system prefix (the traffic
+shape prefix caching exists for). The paged leg runs 2x the dense slot
+count at EQUAL pool HBM (pool_pages x page_size == dense
+slots x max_len): aggregate tok/s, p50/p99 from the same per-request
+completion timestamps, per-engine cache HBM bytes (allocated AND peak
+in use), prefix hit rate, preemption counts, steady compiles (expected
+0 both legs), and a DONE-token equality check between the legs.
+Artifact: benchmarks/serving_paged_bench.json.
+
 ``--serving-batched --chaos`` adds the ROBUSTNESS leg: the same seeded
 arrival stream replayed twice through the batched engine — once clean,
 once under a SEEDED fault schedule (serving/chaos.py: dispatch failures,
@@ -205,6 +217,14 @@ def bench_speculative(preset: str, prompt_len: int, max_new: int,
         outputs_match=f"{matched}/{repeats}",
         platform=jax.devices()[0].platform,
     )
+
+
+def _pct(xs, q):
+    """Nearest-rank percentile over a sequence (the one definition every
+    serving bench leg shares, so p50/p99 can never mean different things
+    in different rows)."""
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
 
 
 def _serving_cfg(dryrun: bool):
@@ -667,10 +687,6 @@ def bench_serving_batched(args) -> list[dict]:
         batched.compile_count() - batched_warm_compiles
     )
 
-    def _pct(xs, q):
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
-
     total_tokens = n_req * max_new
 
     def _leg(span, lat, steady_compiles):
@@ -697,10 +713,195 @@ def bench_serving_batched(args) -> list[dict]:
         "mean_interarrival_ms": round(mean_interarrival * 1e3, 2),
         "arrival_process": "seeded exponential (~2x serial capacity)",
         "serial": _leg(serial_span, serial_lat, serial_steady_compiles),
-        "batched": _leg(
-            batched_span, batched_lat.values(), batched_steady_compiles
+        "batched": dict(
+            _leg(batched_span, batched_lat.values(),
+                 batched_steady_compiles),
+            cache_hbm_bytes=batched.cache_hbm_bytes()["allocated"],
         ),
         "aggregate_speedup": round(serial_span / batched_span, 3),
+        "platform": jax.devices()[0].platform,
+    }
+    return [row]
+
+
+def bench_serving_paged(args) -> list[dict]:
+    """Paged (block-pool) vs dense continuous batching on the SAME
+    seeded arrival stream, at EQUAL pool HBM: the paged engine runs 2x
+    the dense slot count with ``pool_pages * page_size`` equal to the
+    dense ``slots * max_len`` — the ROADMAP direction-1 claim measured
+    (slots scale with the pool because real rows are shallower than
+    max_len and shared prefixes are stored once).
+
+    Every prompt repeats one SHARED SYSTEM PREFIX followed by a random
+    tail — the traffic shape prefix caching exists for; hit rates and
+    preemptions are reported, p50/p99 come from the same per-request
+    completion timestamps as the tok/s (the bench_serving_batched
+    discipline), and the two legs' DONE tokens are compared
+    request-for-request (the test-suite equivalence pin, re-checked on
+    the benched stream)."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.serving.engine import (
+        BatchedDecodeEngine,
+        BucketSpec,
+        PagedBatchedDecodeEngine,
+    )
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _serving_cfg(args.dryrun)
+    dense_slots = 4 if args.dryrun else 8
+    paged_slots = 2 * dense_slots
+    max_new = 12 if args.dryrun else 32
+    max_len = 160 if args.dryrun else 384
+    page = 16
+    chunk = 16 if args.dryrun else 32
+    n_req = 16 if args.dryrun else 48
+    prefix_len = 48 if args.dryrun else 96
+    tail_max = (max_len - max_new - prefix_len) // 2
+    # Equal pool HBM: the paged pool (scratch page included) holds
+    # exactly the dense cache's token positions.
+    pool_pages = dense_slots * max_len // page
+    buckets = BucketSpec.powers_of_two(
+        max_len - max_new, min_bucket=16 if args.dryrun else 32
+    )
+    seed = args.chaos_seed  # reuse the deterministic-artifact seed knob
+    params = get_model(cfg).init(domain_key(seed, "init"), cfg)
+    rng = np.random.default_rng(seed)
+    key = jax.random.key(seed)
+
+    configs = [
+        dict(temperature=0.8, top_k=20),
+        dict(temperature=1.0, top_p=0.9),
+        dict(),  # greedy rows share the batch with sampled ones
+    ]
+    system_prefix = rng.integers(
+        0, cfg.vocab_size, (prefix_len,)
+    ).astype(np.int32)
+    requests = []
+    for i in range(n_req):
+        tail = rng.integers(
+            0, cfg.vocab_size, (int(rng.integers(4, tail_max)),)
+        ).astype(np.int32)
+        kw = dict(configs[i % len(configs)])
+        if kw.get("temperature"):
+            kw["key"] = jax.random.fold_in(key, i)
+        requests.append((np.concatenate([system_prefix, tail]), kw))
+
+    dense = BatchedDecodeEngine(
+        cfg, slots=dense_slots, max_len=max_len, buckets=buckets
+    )
+    paged = PagedBatchedDecodeEngine(
+        cfg, slots=paged_slots, max_len=max_len, page_size=page,
+        prefill_chunk=chunk, pool_pages=pool_pages,
+    )
+    dense.warmup(params)
+    paged.warmup(params)
+    dense_warm = dense.compile_count()
+    paged_warm = paged.compile_count()
+
+    # One arrival schedule for both legs, calibrated to saturate the
+    # DENSE leg (~2x its drain rate) so the extra paged slots have load
+    # to absorb.
+    t0 = time.perf_counter()
+    dense.run(params, [dict(prompt=requests[0][0],
+                            max_new_tokens=max_new, **requests[0][1])])
+    dense.pop_result(0)
+    per_req_est = time.perf_counter() - t0
+    mean_interarrival = per_req_est / (2 * dense_slots)
+    arrivals = np.concatenate(
+        [[0.0], np.cumsum(rng.exponential(mean_interarrival, n_req - 1))]
+    )
+
+    def drive(eng):
+        """(span, {request index: latency}, {request index: result}) —
+        keyed by the arrival stream's request INDEX, not rid (the legs'
+        rid counters differ by the calibration probe)."""
+        clock = 0.0
+        pending = list(zip(arrivals, range(n_req)))
+        submitted: dict[int, float] = {}
+        rid_to_idx: dict[int, int] = {}
+        lat: dict[int, float] = {}
+        while pending or eng.has_work():
+            while pending and pending[0][0] <= clock:
+                arr, i = pending.pop(0)
+                prompt, ckw = requests[i]
+                rid = eng.submit(prompt, max_new, **ckw)
+                submitted[rid] = arr
+                rid_to_idx[rid] = i
+            if not eng.has_work():
+                clock = pending[0][0]
+                continue
+            t0 = time.perf_counter()
+            done = eng.step(params)
+            clock += time.perf_counter() - t0
+            for rid in done:
+                lat[rid_to_idx[rid]] = clock - submitted[rid]
+        span = clock - arrivals[0]
+        results = {
+            rid_to_idx[rid]: eng.pop_result(rid)
+            for rid in list(eng.results)
+        }
+        return span, lat, results
+
+    d_span, d_lat, d_results = drive(dense)
+    p_span, p_lat, p_results = drive(paged)
+    dense_steady = dense.compile_count() - dense_warm
+    paged_steady = paged.compile_count() - paged_warm
+
+    # Equivalence re-checked on the benched stream, request-for-request.
+    matched = sum(
+        int(np.array_equal(d_results[i].tokens, p_results[i].tokens))
+        for i in d_results
+    )
+
+    total_tokens = n_req * max_new
+
+    def _leg(eng, span, lat, steady):
+        hbm = eng.cache_hbm_bytes()
+        lat = list(lat.values())
+        return {
+            "slots": eng.slots,
+            "steady_tokens_per_sec": round(total_tokens / span, 1),
+            "p50_request_ms": round(_pct(lat, 0.50) * 1e3, 2),
+            "p99_request_ms": round(_pct(lat, 0.99) * 1e3, 2),
+            "observed_compile_count_steady": steady,
+            "cache_hbm_bytes": hbm["allocated"],
+            "cache_hbm_bytes_peak_in_use": hbm["peak_in_use"],
+        }
+
+    pool_stats = paged.pool.stats
+    row = {
+        "leg": "serving_paged_stream",
+        "model": dict(
+            n_embd=cfg.n_embd, n_layer=cfg.n_layer,
+            vocab_size=cfg.vocab_size,
+        ),
+        "max_new": max_new,
+        "max_len": max_len,
+        "page_size": page,
+        "prefill_chunk": chunk,
+        "pool_pages": pool_pages,
+        "requests": n_req,
+        "shared_prefix_tokens": prefix_len,
+        "seed": seed,
+        "mean_interarrival_ms": round(mean_interarrival * 1e3, 2),
+        "arrival_process": "seeded exponential (~saturating the dense leg)",
+        "dense": _leg(dense, d_span, d_lat, dense_steady),
+        "paged": _leg(paged, p_span, p_lat, paged_steady),
+        "paged_extras": {
+            "prefix_hit_rate": round(
+                pool_stats["prefix_hits"]
+                / max(1, pool_stats["prefix_queries"]), 3
+            ),
+            "prefix_hit_tokens": pool_stats["prefix_hit_tokens"],
+            "prefix_evictions": pool_stats["evictions"],
+            "preemptions": paged.stats["preemptions"],
+            "peak_pages_in_use": pool_stats["peak_pages_in_use"],
+        },
+        "aggregate_speedup": round(d_span / p_span, 3),
+        "outputs_match": f"{matched}/{n_req}",
         "platform": jax.devices()[0].platform,
     }
     return [row]
@@ -811,10 +1012,6 @@ def bench_serving_chaos(args) -> list[dict]:
         steady = eng.compile_count() - warm
         return span, lat, results, eng.stats, steady
 
-    def _pct(xs, q):
-        xs = sorted(xs)
-        return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
-
     def _leg(span, lat, results, stats, steady):
         good_tokens = sum(
             len(r.tokens) - len(requests[rid][0])
@@ -916,6 +1113,13 @@ def main() -> int:
                          "(BatchedDecodeEngine) vs the serial engine on "
                          "a Poisson-ish mixed-length arrival stream "
                          "(benchmarks/serving_batched_bench.json)")
+    ap.add_argument("--serving-paged", action="store_true",
+                    help="benchmark the paged KV cache "
+                         "(PagedBatchedDecodeEngine: block pool, prefix "
+                         "sharing, chunked prefill) vs the dense batched "
+                         "engine at equal pool HBM on a shared-prefix "
+                         "arrival stream "
+                         "(benchmarks/serving_paged_bench.json)")
     ap.add_argument("--chaos", action="store_true",
                     help="with --serving-batched: add the robustness leg "
                          "— the same seeded arrival stream under a "
@@ -936,7 +1140,7 @@ def main() -> int:
 
     if args.chaos and not args.serving_batched:
         ap.error("--chaos requires --serving-batched")
-    if args.serving or args.serving_batched:
+    if args.serving or args.serving_batched or args.serving_paged:
         rows = []
         if args.serving:
             rows += bench_serving(args)
@@ -945,6 +1149,8 @@ def main() -> int:
                 rows += bench_serving_chaos(args)
             else:
                 rows += bench_serving_batched(args)
+        if args.serving_paged:
+            rows += bench_serving_paged(args)
         for row in rows:
             print(json.dumps(row))
         if args.json:
