@@ -1,13 +1,28 @@
-"""Serving fast path: the persistent donated-KV decode engines (serial
+"""Serving stack: the persistent donated-KV decode engines (serial
 per-request DecodeEngine + slot-scheduled continuous-batching
-BatchedDecodeEngine), the request-lifecycle vocabulary (terminal states,
-results, snapshots — serving/lifecycle.py) and the deterministic
-fault-injection harness (serving/chaos.py)."""
+BatchedDecodeEngine + paged PagedBatchedDecodeEngine), the
+request-lifecycle vocabulary (terminal states, results, snapshots —
+serving/lifecycle.py), the deterministic fault-injection harness
+(serving/chaos.py), the seeded workload generator
+(serving/workload.py), and the serving TIER over them: the
+health-checked multi-replica ReplicaRouter (serving/router.py) and the
+asyncio HTTP/SSE front door (serving/server.py, imported directly to
+keep this package import light)."""
 
 from pytorch_distributed_tpu.serving.chaos import (  # noqa: F401
     Fault,
     FaultInjector,
+    RouterFault,
+    RouterFaultInjector,
     VirtualClock,
+)
+from pytorch_distributed_tpu.serving.router import (  # noqa: F401
+    DEGRADED,
+    DOWN,
+    DRAINED,
+    HEALTHY,
+    REPLICA_STATES,
+    ReplicaRouter,
 )
 from pytorch_distributed_tpu.serving.block_pool import (  # noqa: F401
     BlockPool,
@@ -31,4 +46,5 @@ from pytorch_distributed_tpu.serving.lifecycle import (  # noqa: F401
     PagePoolExhausted,
     RequestFailed,
     RequestResult,
+    RouterOverloaded,
 )

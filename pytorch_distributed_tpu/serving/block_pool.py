@@ -102,6 +102,13 @@ class BlockPool:
 
     # -- accounting --------------------------------------------------------
 
+    def free_pages(self) -> int:
+        """Pages immediately allocatable WITHOUT evicting cached
+        prefixes (the conservative headroom figure ``engine.stats()``
+        reports; eviction can stretch it by the unreferenced cached
+        pages)."""
+        return len(self._free)
+
     def pages_in_use(self) -> int:
         """Pages referenced by at least one live row (the working set —
         what ``decode_bench`` reports as cache HBM actually in use)."""

@@ -147,3 +147,72 @@ class FaultInjector(ScriptedFaults):
                 bad[row] = True
                 self.counts["nan_row"] += 1
         return tok, bad
+
+
+ROUTER_FAULT_KINDS = ("replica_kill",)
+
+
+class RouterFault(_chaos.Fault):
+    """One scripted ROUTER-TIER injection. ``tick`` is the router's step
+    counter (first step = tick 1); ``row`` picks the target replica id
+    (None = seeded choice among the replicas live at fire time)."""
+
+    KINDS = ROUTER_FAULT_KINDS
+
+
+class RouterFaultInjector(ScriptedFaults):
+    """Seeded + scripted replica-death schedule for ``ReplicaRouter``
+    (the router-tier storm): a fired ``replica_kill`` makes the router
+    treat one replica as a lost PROCESS — no exception from the engine,
+    no goodbye; the router's health/failover machinery must notice and
+    convert every in-flight request to a re-routed resume entry. Same
+    ``utils/chaos.ScriptedFaults`` engine as the per-replica
+    ``FaultInjector`` (install THAT on individual replica engines for
+    dispatch/NaN/slow faults; brown-out storms combine both), so a whole
+    router storm is a pure function of its seeds."""
+
+    def __init__(
+        self,
+        faults: tuple[RouterFault, ...] | list[RouterFault] = (),
+        *,
+        seed: int | None = None,
+        p_replica_kill: float = 0.0,
+        clock: VirtualClock | None = None,
+    ) -> None:
+        super().__init__(
+            faults,
+            seed=seed,
+            probabilities={"replica_kill": p_replica_kill},
+            clock=clock,
+            fault_cls=RouterFault,
+        )
+
+    def install(self, router) -> "RouterFaultInjector":
+        router.set_fault_injector(self)
+        return self
+
+    def pop_kill(self, live_ids) -> int | None:
+        """The replica to kill this tick, or None. Scripted faults may
+        pin the target (``row``); seeded draws pick uniformly among the
+        replicas live at fire time (a kill schedule drawn blind could
+        only ever miss). A fault whose pinned target is already down is
+        consumed without effect — the process it models is already
+        dead."""
+        f = self._pop("replica_kill", None)
+        if f is None:
+            return None
+        live_ids = list(live_ids)
+        if f.row is not None:
+            if f.row not in live_ids:
+                return None
+            self._count("replica_kill")
+            return int(f.row)
+        if not live_ids:
+            return None
+        if self._rng is None:
+            # Unseeded scripted faults still need an ADVANCING generator
+            # for target choice — a fresh rng per call would pin every
+            # kill to the same pick.
+            self._rng = np.random.default_rng(0)
+        self._count("replica_kill")
+        return int(live_ids[self._rng.integers(len(live_ids))])

@@ -255,6 +255,32 @@ class DecodeEngine:
         # request ONCE on a fresh zeroed cache, then fails loudly
         # (lifecycle.RequestFailed) instead of returning garbage tokens.
         self._nan_guard = bool(nan_guard)
+        # Monotonic request counters — the serial slice of the uniform
+        # ``stats()`` schema (see BatchedDecodeEngine.stats).
+        self.counters: dict[str, int] = {
+            "requests": 0, "done": 0, "failed": 0, "nan_retries": 0,
+        }
+
+    def stats(self) -> dict[str, Any]:
+        """Uniform engine-state snapshot — one schema across the serial,
+        batched, and paged engines (the router's admission signal reads
+        it without caring which engine backs a replica). The serial
+        engine has no scheduler, so the occupancy fields are the fixed
+        idle values and only ``counters`` carries information; paged-only
+        fields are None on non-paged engines rather than absent, so
+        consumers never need hasattr probes."""
+        return {
+            "engine": type(self).__name__,
+            "queue_depth": 0,
+            "slots": None,
+            "active_rows": 0,
+            "free_slots": None,
+            "pool_pages": None,
+            "free_pages": None,
+            "pages_in_use": None,
+            "prefix_hit_rate": None,
+            "counters": dict(self.counters),
+        }
 
     # -- cache pool --------------------------------------------------------
 
@@ -488,22 +514,26 @@ class DecodeEngine:
         )
         sampled = temperature > 0
         params = self._place_params(params)
+        self.counters["requests"] += 1
         for attempt in range(2 if self._nan_guard else 1):
             out, bad = self._generate_once(
                 params, prompt, padded, b, tp, max_new_tokens, sampled,
                 t, k, p, key, fresh_cache=attempt > 0,
             )
             if not self._nan_guard or not bool(np.asarray(bad).any()):
+                self.counters["done"] += 1
                 return out
             # Poisoned: drop the (pooled) buffer this request ran on and
             # retry once from a fresh zeroed allocation — the one failure
             # mode the masking discipline cannot absolve is a transient
             # corruption inside the request's own live rows.
             self._cache_pool.pop(b, None)
+            self.counters["nan_retries"] += 1
             log_event(
                 "nan_detected", engine="serial", batch=b,
                 attempt=attempt, prompt_len=tp,
             )
+        self.counters["failed"] += 1
         raise RequestFailed(
             "non-finite logits persisted after one fresh-cache retry "
             f"(batch={b}, prompt_len={tp}): the model/params produce "
@@ -576,9 +606,12 @@ class DecodeEngine:
         cache = self._take_cache(b)
         plen = jnp.asarray(tp, jnp.int32)
 
+        self.counters["requests"] += 1
+
         def _guard(bad):
             if self._nan_guard and bool(np.asarray(bad).any()):
                 # Poisoned buffers never rejoin the pool.
+                self.counters["failed"] += 1
                 raise RequestFailed(
                     "non-finite logits mid-stream (batch="
                     f"{b}, prompt_len={tp}): aborting the stream — "
@@ -603,6 +636,7 @@ class DecodeEngine:
                 )
                 _guard(bad)
                 yield tok
+            self.counters["done"] += 1
         except GeneratorExit:
             raise
         except BaseException:
@@ -920,7 +954,10 @@ class BatchedDecodeEngine:
         if pb and pb[-1] < self.max_len:
             pb = pb + (self.max_len,)
         self._prefill_buckets = pb  # () = exact-length mode
-        self.stats: dict[str, int] = {
+        # Monotonic event counters (terminal states + fault/recovery
+        # tallies). The point-in-time scheduler view lives in ``stats()``
+        # — the router's admission signal — which embeds a copy of these.
+        self.counters: dict[str, int] = {
             "done": 0, "failed": 0, "aborted": 0, "expired": 0,
             "nan_quarantines": 0, "dispatch_failures": 0, "resumes": 0,
             "cache_allocs": 0,
@@ -929,7 +966,7 @@ class BatchedDecodeEngine:
     # -- cache -------------------------------------------------------------
 
     def _new_cache(self) -> decode.Cache:
-        self.stats["cache_allocs"] += 1
+        self.counters["cache_allocs"] += 1
         if self.mode == "tp":
             full = decode.init_cache(self.cfg, self.slots, self.max_len)
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1335,7 +1372,7 @@ class BatchedDecodeEngine:
             pending=inflight + queued,
             next_rid=self._next_rid,
             results=dict(self.results),
-            stats=dict(self.stats),
+            stats=dict(self.counters),
         )
 
     def restore(self, snap: EngineSnapshot) -> None:
@@ -1374,6 +1411,61 @@ class BatchedDecodeEngine:
             "restore", t=round(self._clock(), 6),
             pending=len(snap.pending), next_rid=snap.next_rid,
         )
+
+    def adopt(self, entries) -> dict[int, int]:
+        """Take over queued/resume entries from ANOTHER engine — the
+        router's failover path: when a replica dies, its host-side
+        entries (in-flight rows already converted to resume entries
+        carrying tokens-so-far + the pre-folded PRNG schedule) are
+        adopted by survivors and continue BIT-IDENTICALLY, because the
+        continuation depends only on the entry and the (shared) params,
+        never on which engine runs it. Unlike ``restore`` this works on
+        a BUSY engine: each entry is assigned THIS engine's next rid
+        (the donor's rids would collide) and appended in the order
+        given — adopted work queues behind traffic already admitted
+        here, which is the deterministic choice a router can reason
+        about. Returns {donor_rid: adopted_rid}; the caller (the
+        router) owns the mapping."""
+        entries = list(entries)
+        # Validate EVERYTHING before touching the queue: a mixed batch
+        # with one oversized entry must not half-adopt (the caller would
+        # have no mapping for the entries already enqueued).
+        for q in entries:
+            if len(q.prompt) + q.max_new > self.max_len:
+                raise ValueError(
+                    f"adopted entry rid {q.rid} needs "
+                    f"{len(q.prompt) + q.max_new} cache positions "
+                    f"but this engine's max_len is {self.max_len}"
+                )
+        mapping: dict[int, int] = {}
+        for q in entries:
+            prefix = len(q.prompt) + len(q.gen)
+            rid = self._next_rid
+            self._next_rid += 1
+            bucket = (
+                self._resume_bucket(prefix)
+                if q.gen
+                else self.buckets.bucket_for(len(q.prompt))
+            )
+            self._queue.append(dataclasses.replace(
+                q, rid=rid, bucket=bucket, gen=list(q.gen)
+            ))
+            mapping[q.rid] = rid
+        return mapping
+
+    def peek_tokens(self, rid: int) -> np.ndarray | None:
+        """Tokens-so-far for a live OR terminal request (prompt + every
+        clean token generated to date) — the host-side progress read the
+        SSE streaming front door polls between ticks. None for unknown
+        rids; never touches device state."""
+        for s in self._slots:
+            if s is not None and s.rid == rid:
+                return self._partial_tokens(s.prompt, s.generated)
+        for q in self._queue:
+            if q.rid == rid:
+                return self._partial_tokens(q.prompt, q.gen)
+        res = self.results.get(rid)
+        return None if res is None else np.asarray(res.tokens)
 
     # -- scheduler internals -----------------------------------------------
 
@@ -1426,7 +1518,7 @@ class BatchedDecodeEngine:
         self.results[rid] = RequestResult(
             rid=rid, state=state, tokens=tokens, reason=reason
         )
-        self.stats[state.lower()] += 1
+        self.counters[state.lower()] += 1
         if finished is not None:
             finished.append(rid)
         log_event(
@@ -1606,7 +1698,7 @@ class BatchedDecodeEngine:
         """Non-finite logits in an admission prefill: the garbage token
         is discarded and the request retried once over a freshly
         re-prefilled row, then FAILED."""
-        self.stats["nan_quarantines"] += 1
+        self.counters["nan_quarantines"] += 1
         if req.nan_retried:
             self._finish_pending(
                 req, FAILED,
@@ -1630,7 +1722,7 @@ class BatchedDecodeEngine:
         then FAILED on recurrence. ``phase`` labels the lifecycle log
         and failure reason (the paged engine's chunked prefill
         quarantines through here too)."""
-        self.stats["nan_quarantines"] += 1
+        self.counters["nan_quarantines"] += 1
         if s.nan_retried:
             self._finish_slot(
                 s, FAILED,
@@ -1682,7 +1774,7 @@ class BatchedDecodeEngine:
 
     def _recover_dispatch_failure(self, kind, err, group_pendings,
                                   finished) -> None:
-        self.stats["dispatch_failures"] += 1
+        self.counters["dispatch_failures"] += 1
         self._fail_streak += 1
         log_event(
             "dispatch_fail", kind=kind, tick=self._ticks,
@@ -1709,7 +1801,7 @@ class BatchedDecodeEngine:
                     "fault-resume retries", finished,
                 )
             else:
-                self.stats["resumes"] += 1
+                self.counters["resumes"] += 1
                 kept.append(q)
         self._requeue(kept)
         if (
@@ -1747,6 +1839,27 @@ class BatchedDecodeEngine:
         the row's page references here."""
 
     # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict[str, Any]:
+        """Uniform engine-state snapshot: scheduler occupancy (queue
+        depth, active rows, free slots) + page-pool pressure (None on
+        non-paged engines — same keys everywhere, so the router's
+        admission scoring reads one schema regardless of which engine
+        backs a replica) + a copy of the monotonic ``counters``. Pure
+        host bookkeeping; never dispatches."""
+        free_slots = sum(1 for s in self._slots if s is None)
+        return {
+            "engine": type(self).__name__,
+            "queue_depth": len(self._queue),
+            "slots": self.slots,
+            "active_rows": self.slots - free_slots,
+            "free_slots": free_slots,
+            "pool_pages": None,
+            "free_pages": None,
+            "pages_in_use": None,
+            "prefix_hit_rate": None,
+            "counters": dict(self.counters),
+        }
 
     def compile_count(self) -> int:
         """Total compiled executables across both programs: ONE
@@ -1994,12 +2107,12 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 f"'kernel_interpret', got {paged_attention!r}"
             )
         self._paged_impl = paged_attention
-        self.stats["preemptions"] = 0
+        self.counters["preemptions"] = 0
 
     # -- cache -------------------------------------------------------------
 
     def _new_cache(self) -> decode.Cache:
-        self.stats["cache_allocs"] += 1
+        self.counters["cache_allocs"] += 1
         if self.mode == "tp":
             full = decode.init_paged_cache(
                 self.cfg, self.pool_pages, self.page_size
@@ -2029,6 +2142,23 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 self.pool.stats["peak_pages_in_use"] * self.page_size * per
             ),
         }
+
+    def stats(self) -> dict[str, Any]:
+        """The uniform snapshot with the paged fields filled in: page
+        pressure (free/in-use against the pool) is the second admission
+        signal the router weighs next to queue depth — closing the gap
+        where ``pool.stats`` was a paged-only side channel."""
+        out = super().stats()
+        ps = self.pool.stats
+        out.update(
+            pool_pages=self.pool_pages,
+            free_pages=self.pool.free_pages(),
+            pages_in_use=self.pool.pages_in_use(),
+            prefix_hit_rate=round(
+                ps["prefix_hits"] / max(1, ps["prefix_queries"]), 4
+            ),
+        )
+        return out
 
     # -- programs ----------------------------------------------------------
 
@@ -2348,7 +2478,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         s = self._slots[row]
         self._slots[row] = None
         self._on_slot_freed(s)
-        self.stats["preemptions"] += 1
+        self.counters["preemptions"] += 1
         log_event(
             "preempt", rid=rid, row=row, depth=s.pos,
             generated=len(s.generated) - s.resume_base,

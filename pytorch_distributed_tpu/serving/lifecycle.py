@@ -116,3 +116,17 @@ class DispatchFailure(RuntimeError):
     this raises: every in-flight request has been requeued (or FAILED if
     out of resume budget) and the cache dropped — the caller can
     ``snapshot()`` and rebuild, or keep the engine and try again later."""
+
+
+class RouterOverloaded(RuntimeError):
+    """SLO-aware load shedding (`serving/router.py`): every routable
+    replica is past its admission thresholds (queue depth and/or page
+    headroom), so the router rejects LOUDLY instead of queueing without
+    bound — unbounded queues turn overload into unbounded p99, which is
+    worse than a clean 429. ``retry_after_s`` is the router's drain-time
+    estimate; the HTTP front door maps it onto a ``Retry-After``
+    header."""
+
+    def __init__(self, message: str, *, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s

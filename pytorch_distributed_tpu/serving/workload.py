@@ -1,0 +1,125 @@
+"""Seeded serving workloads: ONE arrival-stream generator for every
+consumer.
+
+Before this module, three near-copies of "seeded Poisson-ish mixed
+traffic" lived in ``scripts/soak.py`` and the ``decode_bench``
+serving legs — and they had already drifted on the details that decide
+whether two runs are comparable: one drew a per-request key as
+``jax.random.key(base + i)``, another as ``fold_in(key(base), i)``, a
+third shared ONE key across every sampled request. A robustness claim
+("DONE outputs bit-equal to a fault-free run of the same schedule") is
+only meaningful when "the same schedule" is a single function of the
+seed, so the generator lives here and the soak, the bench legs, the
+router load generator, and the tests all consume it.
+
+Conventions (the points the copies drifted on, now pinned):
+
+- **Per-request keys** are ``fold_in(jax.random.key(key_seed), i)`` —
+  one base key, folded by request index. Requests are independent
+  streams whatever engine or replica serves them.
+- **Sampling configs** cycle through ``sampling_cycle`` by request
+  index (greedy rows share batches with sampled ones by default).
+- **Arrivals** are exponential inter-arrival times (Poisson process)
+  from the SAME generator that drew the requests, so one seed fixes
+  offered load and content together.
+
+Everything returns plain host data (numpy arrays + ``submit`` kwarg
+dicts); nothing here touches a device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Greedy rows deliberately share the stream with sampled ones: the
+# batched engines' per-row traced sampling state is exactly what makes
+# that free, and a workload without the mix would under-exercise it.
+DEFAULT_SAMPLING_CYCLE = (
+    dict(temperature=0.8, top_k=20),
+    dict(temperature=1.0, top_p=0.9),
+    dict(),  # greedy
+)
+
+
+def request_stream(
+    rng: np.random.Generator,
+    *,
+    n: int,
+    vocab_size: int,
+    prompt_len: tuple[int, int],
+    max_new: int | tuple[int, int],
+    sampling_cycle=DEFAULT_SAMPLING_CYCLE,
+    key_seed: int | None = None,
+    shared_prefix: np.ndarray | None = None,
+    p_deadline: float = 0.0,
+    deadline_range: tuple[float, float] = (0.5, 4.0),
+) -> list[dict]:
+    """The seeded request schedule: a list of ``engine.submit`` /
+    ``router.submit`` kwarg dicts (prompt, max_new_tokens, sampling
+    config, per-request key, optional ``timeout_s`` deadline).
+
+    ``prompt_len`` draws uniformly over [lo, hi] inclusive (the random
+    TAIL length when ``shared_prefix`` is given — the prefix-cache
+    traffic shape); ``max_new`` is fixed or a [lo, hi] draw;
+    ``p_deadline`` attaches a ``timeout_s`` drawn from
+    ``deadline_range`` to that fraction of requests (engine-clock
+    seconds — drive with a VirtualClock to make expiries replayable).
+    ``key_seed`` defaults to a draw from ``rng`` so the whole stream
+    stays a pure function of the caller's seed either way."""
+    import jax
+
+    if key_seed is None:
+        key_seed = int(rng.integers(0, 2**31 - 1))
+    base_key = None  # built lazily: greedy-only streams never need jax
+    lo, hi = prompt_len
+    reqs: list[dict] = []
+    for i in range(n):
+        tp = int(rng.integers(lo, hi + 1))
+        tail = rng.integers(0, vocab_size, (tp,)).astype(np.int32)
+        prompt = (
+            tail if shared_prefix is None
+            else np.concatenate([np.asarray(shared_prefix, np.int32), tail])
+        )
+        mn = (
+            int(max_new) if isinstance(max_new, int)
+            else int(rng.integers(max_new[0], max_new[1] + 1))
+        )
+        kw = dict(sampling_cycle[i % len(sampling_cycle)])
+        if kw.get("temperature"):
+            if base_key is None:
+                base_key = jax.random.key(key_seed)
+            kw["key"] = jax.random.fold_in(base_key, i)
+        # The deadline Bernoulli draws UNCONDITIONALLY so the request
+        # content downstream of request i is identical whether or not
+        # this stream uses deadlines — legs with and without them stay
+        # comparable request-for-request.
+        u, d = rng.random(), float(rng.uniform(*deadline_range))
+        if u < p_deadline:
+            kw["timeout_s"] = d
+        reqs.append(dict(prompt=prompt, max_new_tokens=mn, **kw))
+    return reqs
+
+
+def exponential_arrivals(
+    rng: np.random.Generator, n: int, mean_interarrival_s: float,
+    start: float = 0.0,
+) -> np.ndarray:
+    """Arrival timestamps of a Poisson process: the first request lands
+    at ``start``, the rest follow exponential inter-arrival gaps. Every
+    serving bench leg calibrates ``mean_interarrival_s`` against a
+    measured service rate and then replays ONE schedule through every
+    leg under comparison."""
+    if n < 1:
+        return np.zeros((0,))
+    gaps = rng.exponential(mean_interarrival_s, n - 1)
+    return start + np.concatenate([[0.0], np.cumsum(gaps)])
+
+
+def tick_bursts(
+    rng: np.random.Generator, max_per_tick: int, length: int = 997
+) -> list[int]:
+    """Seeded per-tick arrival burst sizes (0..max_per_tick inclusive)
+    for tick-driven drivers (the soak): bursty, seed-reproducible churn
+    without a wall clock. A long prime-length cycle avoids resonating
+    with the scheduler's own periodicities."""
+    return [int(rng.integers(0, max_per_tick + 1)) for _ in range(length)]

@@ -585,6 +585,11 @@ def bench_serving_batched(args) -> list[dict]:
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.utils.prng import domain_key
 
+    from pytorch_distributed_tpu.serving.workload import (
+        exponential_arrivals,
+        request_stream,
+    )
+
     cfg = _serving_cfg(args.dryrun)
     slots = 4 if args.dryrun else 8
     max_new = 12 if args.dryrun else 32
@@ -596,63 +601,57 @@ def bench_serving_batched(args) -> list[dict]:
     seed = int.from_bytes(os.urandom(4), "little")
     params = get_model(cfg).init(domain_key(seed, "init"), cfg)
     rng = np.random.default_rng(seed)
-    key = jax.random.key(seed)
 
-    configs = [
-        dict(temperature=0.8, top_k=20),
-        dict(temperature=1.0, top_p=0.9),
-        dict(),  # greedy rows share the batch with sampled ones
-    ]
-    lengths = [
-        int(x) for x in rng.integers(4, buckets.buckets[-1] + 1, n_req)
-    ]
-    requests = [
-        (
-            np.asarray(
-                rng.integers(0, cfg.vocab_size, (tp,)), np.int32
-            ),
-            configs[i % len(configs)],
-        )
-        for i, tp in enumerate(lengths)
-    ]
+    # The shared seeded workload (serving/workload.py): mixed lengths,
+    # greedy + sampled rows, per-request folded keys.
+    requests = request_stream(
+        rng, n=n_req, vocab_size=cfg.vocab_size,
+        prompt_len=(4, buckets.buckets[-1]), max_new=max_new,
+        key_seed=seed,
+    )
+    n_sampling_configs = 3  # DEFAULT_SAMPLING_CYCLE
 
     serial = DecodeEngine(cfg, max_len=max_len, buckets=buckets)
     batched = BatchedDecodeEngine(
         cfg, slots=slots, max_len=max_len, buckets=buckets
     )
 
-    def serial_call(prompt, ckw):
-        kw = dict(ckw)
-        if kw.get("temperature"):
-            kw["key"] = key
-        out = serial.generate(params, prompt[None], max_new, **kw)
+    def serial_call(req):
+        kw = {
+            k: v for k, v in req.items()
+            if k not in ("prompt", "max_new_tokens")
+        }
+        out = serial.generate(
+            params, np.asarray(req["prompt"])[None],
+            req["max_new_tokens"], **kw,
+        )
         np.asarray(out)  # fence
 
     # Warm both legs (charged to warmup, outside the measured stream).
     for tp in buckets.buckets:
         p_warm = np.zeros((min(tp, max_len - max_new),), np.int32)
-        serial_call(p_warm, configs[0])
-        serial_call(p_warm, configs[2])
+        serial_call(dict(prompt=p_warm, max_new_tokens=max_new,
+                         temperature=0.8, top_k=20,
+                         key=jax.random.key(0)))
+        serial_call(dict(prompt=p_warm, max_new_tokens=max_new))
     batched.warmup(params)
     serial_warm_compiles = serial.compile_count()
     batched_warm_compiles = batched.compile_count()
 
     # Calibrate the arrival process to the serial engine's service rate.
     t0 = time.perf_counter()
-    serial_call(requests[0][0], requests[0][1])
+    serial_call(requests[0])
     service_est = time.perf_counter() - t0
     mean_interarrival = service_est / 2.0  # ~2x serial capacity
-    arrivals = np.concatenate(
-        [[0.0], np.cumsum(rng.exponential(mean_interarrival, n_req - 1))]
-    )
+    arrivals = exponential_arrivals(rng, n_req, mean_interarrival)
 
     # Serial leg: FIFO, one request at a time, virtual clock over
     # measured service times.
     clock = 0.0
     serial_lat = []
-    for arr, (prompt, ckw) in zip(arrivals, requests):
+    for arr, req in zip(arrivals, requests):
         t0 = time.perf_counter()
-        serial_call(prompt, ckw)
+        serial_call(req)
         dt = time.perf_counter() - t0
         clock = max(clock, arr) + dt
         serial_lat.append(clock - arr)
@@ -668,11 +667,7 @@ def bench_serving_batched(args) -> list[dict]:
     while pending or batched.has_work():
         while pending and pending[0][0] <= clock:
             arr, i = pending.pop(0)
-            prompt, ckw = requests[i]
-            kw = dict(ckw)
-            if kw.get("temperature"):
-                kw["key"] = key
-            rid = batched.submit(prompt, max_new, **kw)
+            rid = batched.submit(**requests[i])
             submitted[rid] = arr
         if not batched.has_work():
             clock = pending[0][0]  # idle until the next arrival
@@ -709,7 +704,7 @@ def bench_serving_batched(args) -> list[dict]:
         "max_len": max_len,
         "requests": n_req,
         "buckets": list(buckets.buckets),
-        "sampling_configs": len(configs),
+        "sampling_configs": n_sampling_configs,
         "mean_interarrival_ms": round(mean_interarrival * 1e3, 2),
         "arrival_process": "seeded exponential (~2x serial capacity)",
         "serial": _leg(serial_span, serial_lat, serial_steady_compiles),
@@ -769,25 +764,23 @@ def bench_serving_paged(args) -> list[dict]:
     seed = args.chaos_seed  # reuse the deterministic-artifact seed knob
     params = get_model(cfg).init(domain_key(seed, "init"), cfg)
     rng = np.random.default_rng(seed)
-    key = jax.random.key(seed)
 
-    configs = [
-        dict(temperature=0.8, top_k=20),
-        dict(temperature=1.0, top_p=0.9),
-        dict(),  # greedy rows share the batch with sampled ones
-    ]
+    # The shared seeded workload (serving/workload.py): every prompt
+    # repeats one shared system prefix followed by a random tail — the
+    # traffic shape prefix caching exists for.
+    from pytorch_distributed_tpu.serving.workload import (
+        exponential_arrivals,
+        request_stream,
+    )
+
     system_prefix = rng.integers(
         0, cfg.vocab_size, (prefix_len,)
     ).astype(np.int32)
-    requests = []
-    for i in range(n_req):
-        tail = rng.integers(
-            0, cfg.vocab_size, (int(rng.integers(4, tail_max)),)
-        ).astype(np.int32)
-        kw = dict(configs[i % len(configs)])
-        if kw.get("temperature"):
-            kw["key"] = jax.random.fold_in(key, i)
-        requests.append((np.concatenate([system_prefix, tail]), kw))
+    requests = request_stream(
+        rng, n=n_req, vocab_size=cfg.vocab_size,
+        prompt_len=(4, tail_max - 1), max_new=max_new, key_seed=seed,
+        shared_prefix=system_prefix,
+    )
 
     dense = BatchedDecodeEngine(
         cfg, slots=dense_slots, max_len=max_len, buckets=buckets
@@ -805,14 +798,11 @@ def bench_serving_paged(args) -> list[dict]:
     # DENSE leg (~2x its drain rate) so the extra paged slots have load
     # to absorb.
     t0 = time.perf_counter()
-    dense.run(params, [dict(prompt=requests[0][0],
-                            max_new_tokens=max_new, **requests[0][1])])
+    dense.run(params, [requests[0]])
     dense.pop_result(0)
     per_req_est = time.perf_counter() - t0
     mean_interarrival = per_req_est / (2 * dense_slots)
-    arrivals = np.concatenate(
-        [[0.0], np.cumsum(rng.exponential(mean_interarrival, n_req - 1))]
-    )
+    arrivals = exponential_arrivals(rng, n_req, mean_interarrival)
 
     def drive(eng):
         """(span, {request index: latency}, {request index: result}) —
@@ -826,8 +816,7 @@ def bench_serving_paged(args) -> list[dict]:
         while pending or eng.has_work():
             while pending and pending[0][0] <= clock:
                 arr, i = pending.pop(0)
-                prompt, ckw = requests[i]
-                rid = eng.submit(prompt, max_new, **ckw)
+                rid = eng.submit(**requests[i])
                 submitted[rid] = arr
                 rid_to_idx[rid] = i
             if not eng.has_work():
@@ -897,7 +886,7 @@ def bench_serving_paged(args) -> list[dict]:
             ),
             "prefix_hit_tokens": pool_stats["prefix_hit_tokens"],
             "prefix_evictions": pool_stats["evictions"],
-            "preemptions": paged.stats["preemptions"],
+            "preemptions": paged.counters["preemptions"],
             "peak_pages_in_use": pool_stats["peak_pages_in_use"],
         },
         "aggregate_speedup": round(d_span / p_span, 3),
@@ -944,23 +933,19 @@ def bench_serving_chaos(args) -> list[dict]:
     seed = args.chaos_seed
     params = get_model(cfg).init(domain_key(seed, "init"), cfg)
     rng = np.random.default_rng(seed)
-    key = jax.random.key(seed)
 
-    configs = [
-        dict(temperature=0.8, top_k=20),
-        dict(temperature=1.0, top_p=0.9),
-        dict(),
-    ]
-    requests = []
-    for i in range(n_req):
-        tp = int(rng.integers(4, buckets.buckets[-1] + 1))
-        kw = dict(configs[i % len(configs)])
-        if kw.get("temperature"):
-            kw["key"] = jax.random.fold_in(key, i)
-        requests.append((
-            np.asarray(rng.integers(0, cfg.vocab_size, (tp,)), np.int32),
-            kw,
-        ))
+    # The shared seeded workload (serving/workload.py) — the schedule is
+    # a pure function of --chaos-seed, so the artifact reproduces.
+    from pytorch_distributed_tpu.serving.workload import (
+        exponential_arrivals,
+        request_stream,
+    )
+
+    requests = request_stream(
+        rng, n=n_req, vocab_size=cfg.vocab_size,
+        prompt_len=(4, buckets.buckets[-1]), max_new=max_new,
+        key_seed=seed,
+    )
 
     def make_engine():
         return BatchedDecodeEngine(
@@ -975,13 +960,10 @@ def bench_serving_chaos(args) -> list[dict]:
     probe = make_engine()
     probe.warmup(params)
     t0 = time.perf_counter()
-    probe.run(params, [dict(prompt=requests[0][0],
-                            max_new_tokens=max_new, **requests[0][1])])
+    probe.run(params, [requests[0]])
     per_req_est = time.perf_counter() - t0
     mean_interarrival = per_req_est / max(2, slots // 2)
-    arrivals = np.concatenate(
-        [[0.0], np.cumsum(rng.exponential(mean_interarrival, n_req - 1))]
-    )
+    arrivals = exponential_arrivals(rng, n_req, mean_interarrival)
 
     def drive(injector):
         eng = make_engine()
@@ -996,8 +978,7 @@ def bench_serving_chaos(args) -> list[dict]:
         while pending or eng.has_work():
             while pending and pending[0][0] <= clock:
                 arr, i = pending.pop(0)
-                prompt, ckw = requests[i]
-                rid = eng.submit(prompt, max_new, **ckw)
+                rid = eng.submit(**requests[i])
                 submitted[rid] = arr
             if not eng.has_work():
                 clock = pending[0][0]
@@ -1010,11 +991,11 @@ def bench_serving_chaos(args) -> list[dict]:
         span = clock - arrivals[0]
         results = {rid: eng.pop_result(rid) for rid in list(eng.results)}
         steady = eng.compile_count() - warm
-        return span, lat, results, eng.stats, steady
+        return span, lat, results, eng.counters, steady
 
     def _leg(span, lat, results, stats, steady):
         good_tokens = sum(
-            len(r.tokens) - len(requests[rid][0])
+            len(r.tokens) - len(requests[rid]["prompt"])
             for rid, r in results.items() if r.state == DONE
         )
         lat = list(lat.values())

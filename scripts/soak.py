@@ -47,34 +47,26 @@ from _common import setup_platform  # noqa: F401  (sys.path side effect)
 
 def _build_requests(rng, cfg, n_req, max_len, *, key_seeds,
                     deadline_range=(0.5, 4.0)):
-    """The seeded request schedule: prompts, budgets, sampling configs,
-    deadlines. Shared VERBATIM by the chaos and fault-free legs."""
-    import jax
-    import numpy as np
+    """The seeded request schedule, from the ONE shared generator
+    (serving/workload.py) every bench/soak/loadgen leg consumes.
+    Shared VERBATIM by the chaos and fault-free legs. A third of the
+    stream carries a deadline tight enough that the injected slow_tick
+    stalls expire some of them (virtual time — the fault-free leg's
+    clock never advances, so ITS deadlines never fire and the all-DONE
+    reference stays intact)."""
+    from pytorch_distributed_tpu.serving.workload import request_stream
 
-    reqs = []
-    for i in range(n_req):
-        tp = int(rng.integers(3, 17))
-        max_new = int(rng.integers(1, 9))
-        kind = int(rng.integers(0, 3))
-        kw = {}
-        if kind == 1:
-            kw = dict(temperature=0.9, top_k=17,
-                      key=jax.random.key(key_seeds + i))
-        elif kind == 2:
-            kw = dict(temperature=1.1, top_p=0.9,
-                      key=jax.random.key(key_seeds + i))
-        # A third of the stream carries a deadline tight enough that the
-        # injected slow_tick stalls expire some of them (virtual time —
-        # the fault-free leg's clock never advances, so ITS deadlines
-        # never fire and the all-DONE reference stays intact).
-        if rng.random() < 0.33:
-            kw["timeout_s"] = float(rng.uniform(*deadline_range))
-        prompt = np.asarray(
-            rng.integers(0, cfg.vocab_size, (tp,)), np.int32
-        )
-        reqs.append(dict(prompt=prompt, max_new_tokens=max_new, **kw))
-    return reqs
+    return request_stream(
+        rng, n=n_req, vocab_size=cfg.vocab_size, prompt_len=(3, 16),
+        max_new=(1, 8),
+        sampling_cycle=(
+            dict(temperature=0.9, top_k=17),
+            dict(temperature=1.1, top_p=0.9),
+            dict(),
+        ),
+        key_seed=key_seeds, p_deadline=0.33,
+        deadline_range=deadline_range,
+    )
 
 
 def _drive(engine, params, reqs, *, injector, abort_rng, p_abort,
@@ -176,7 +168,9 @@ def run_soak(args) -> dict:
     )
     # Seeded per-tick arrival burst sizes (a long cycle is plenty —
     # the point is bursty, seed-reproducible churn).
-    rng_draws = [int(rng.integers(0, 3)) for _ in range(997)]
+    from pytorch_distributed_tpu.serving.workload import tick_bursts
+
+    rng_draws = tick_bursts(rng, 2)
 
     def make_engine(*, clock, sleep):
         return BatchedDecodeEngine(
@@ -267,9 +261,9 @@ def run_soak(args) -> dict:
     # 4. Bounded cache: warmup alloc + one per dispatch failure + one per
     #    rebuild (the donated buffer is consumed by the failed dispatch).
     total_failures = sum(
-        e.stats["dispatch_failures"] for e in engines
+        e.counters["dispatch_failures"] for e in engines
     )
-    total_allocs = sum(e.stats["cache_allocs"] for e in engines)
+    total_allocs = sum(e.counters["cache_allocs"] for e in engines)
     alloc_bound = len(engines) + total_failures
     if total_allocs > alloc_bound:
         failures.append(
@@ -298,7 +292,7 @@ def run_soak(args) -> dict:
         "virtual_time_s": round(clock.now, 3),
         "terminal_states": by_state,
         "fault_counts": injector.counts,
-        "engine_stats": [dict(e.stats) for e in engines],
+        "engine_counters": [dict(e.counters) for e in engines],
         "engine_rebuilds": len(engines) - 1,
         "steady_compiles": [
             e.compile_count() - getattr(e, "_warm_count", warm)

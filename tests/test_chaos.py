@@ -264,7 +264,7 @@ def test_batched_engine_quarantines_then_fails_on_nan_params():
         assert out[rid].state == FAILED
         assert "quarantine retry" in out[rid].reason
         np.testing.assert_array_equal(out[rid].tokens, req["prompt"])
-    assert eng.stats["nan_quarantines"] == 4  # 2 requests x (hit + retry)
+    assert eng.counters["nan_quarantines"] == 4  # 2 requests x (hit + retry)
     assert not eng.has_work()
 
 
@@ -286,7 +286,7 @@ def test_nan_quarantine_isolates_row():
     # so the resumed row re-derives it bit-identically.
     FaultInjector([Fault(tick=3, kind="nan_row", row=0)]).install(eng)
     out = eng.run(params, reqs)
-    assert eng.stats["nan_quarantines"] == 1
+    assert eng.counters["nan_quarantines"] == 1
     for rid in (0, 1):
         assert out[rid].state == DONE
         np.testing.assert_array_equal(
@@ -310,8 +310,8 @@ def test_dropped_result_recovers_token_equal():
     eng = _engine(cfg)
     FaultInjector([Fault(tick=2, kind="drop_result")]).install(eng)
     out = eng.run(params, reqs)
-    assert eng.stats["dispatch_failures"] == 1
-    assert eng.stats["resumes"] == 2
+    assert eng.counters["dispatch_failures"] == 1
+    assert eng.counters["resumes"] == 2
     for rid in (0, 1):
         assert out[rid].state == DONE
         np.testing.assert_array_equal(

@@ -361,7 +361,7 @@ def test_failed_dispatch_resumes_in_flight_and_spares_queued():
     assert eng.active_rids() == []
     assert eng._cache is None  # dropped, not poisoned
     assert eng.queued_rids() == [r0, r1]  # resume ahead of queued FIFO
-    assert eng.stats["dispatch_failures"] == 1
+    assert eng.counters["dispatch_failures"] == 1
     out = eng.run(params)
     for rid in (r0, r1):
         assert out[rid].state == "DONE"

@@ -214,8 +214,8 @@ def test_pool_exhaustion_preempts_youngest_and_resumes():
         pool_pages=6,
     )
     out = paged.run(params, reqs)
-    assert paged.stats["preemptions"] >= 1
-    assert paged.stats["failed"] == 0  # preemption is not a fault
+    assert paged.counters["preemptions"] >= 1
+    assert paged.counters["failed"] == 0  # preemption is not a fault
     for rid in (0, 1):
         assert out[rid].state == "DONE"
         np.testing.assert_array_equal(
@@ -248,7 +248,7 @@ def test_simultaneous_boundary_crossing_leaks_no_pages():
         pool_pages=8,
     )
     out = paged.run(params, reqs)
-    assert paged.stats["preemptions"] >= 1
+    assert paged.counters["preemptions"] >= 1
     for rid in range(3):
         assert out[rid].state == "DONE"
         np.testing.assert_array_equal(
@@ -384,7 +384,7 @@ def test_dispatch_failure_resets_pool_and_resumes_bit_identical():
         eng.step(params)
     assert eng._cache is None  # donated buffer consumed
     assert eng.pool.pages_resident() == 0  # pool + prefix cache reset
-    assert eng.stats["dispatch_failures"] == 1
+    assert eng.counters["dispatch_failures"] == 1
     out = eng.run(params)
     for rid in (r0, r1):
         assert out[rid].state == "DONE"
@@ -454,7 +454,7 @@ def test_quarantine_bypasses_prefix_cache():
     ).install(eng)
     rid = eng.submit(**req)
     out = eng.run(params)
-    assert eng.stats["nan_quarantines"] == 1
+    assert eng.counters["nan_quarantines"] == 1
     # The first admission queried (and HIT) the cache; the
     # post-quarantine re-admit deliberately queried NOTHING — a cached
     # page could carry the very poison the retry is escaping.
