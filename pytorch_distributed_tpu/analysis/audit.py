@@ -214,6 +214,85 @@ def check_dtype(
     return findings
 
 
+def check_q8_casts(
+    summary: JaxprSummary, budget: dict[str, int]
+) -> tuple[list[Finding], dict[str, int]]:
+    """The dtype-leak check extended to the quantized serving path: pin
+    the program's int8 cast counts to its DECLARED quantize/dequantize
+    sites.
+
+    A quantized program has an EXACT cast inventory — one f32->int8
+    convert per cache append (quantize-on-write: K and V), one
+    int8->float per cache read (dequant-on-gather) and per weight-only
+    matmul (the in-register kernel upcast) — and the check is an
+    equality, not a ceiling, because BOTH directions of drift are real
+    bugs:
+
+    - MORE converts than declared: a silent full-precision round-trip —
+      a dequantized pool being re-quantized (lossy: every round-trip
+      re-rounds), or an int8 tensor materialised wide ahead of a
+      consumer that should read it narrow (the bandwidth quantization
+      existed to save, spent invisibly);
+    - FEWER converts than declared: the path silently stopped
+      quantizing — e.g. a renamed param key drops a projection out of
+      QUANT_WEIGHT_SUFFIXES and the engine serves full-precision
+      weights while every quality budget trivially passes (the path IS
+      f32). The inventory is the only thing that notices.
+
+    The registry's q8 cases carry the measured budgets
+    (``q8_cast_budget={"to_int8": n, "from_int8": m}``) the way
+    max_counts pins collective ceilings. Returns (findings, observed
+    counts) so the report's summary quotes the same numbers the
+    findings were judged on.
+    """
+    to_i8 = [c for c in summary.converts if c.out_dtype == "int8"]
+    from_i8 = [c for c in summary.converts if c.in_dtype == "int8"]
+    counts = {"to_int8": len(to_i8), "from_int8": len(from_i8)}
+    findings: list[Finding] = []
+
+    def diff(key, n, kind, extra_msg, missing_msg):
+        want = budget.get(key)
+        if want is None or n == want:
+            return
+        findings.append(
+            Finding(
+                checker="dtype",
+                code=(
+                    f"q8-extra-{kind}" if n > want
+                    else f"q8-missing-{kind}"
+                ),
+                severity="error",
+                message=(
+                    f"{n} {key.replace('_', ' ')} converts but the "
+                    f"program declares {want} {kind} site(s): "
+                    + (extra_msg if n > want else missing_msg)
+                ),
+                detail={"count": n, "declared": want},
+            )
+        )
+
+    diff(
+        "to_int8", counts["to_int8"], "quantize",
+        "something re-quantizes already-quantized data — a silent f32 "
+        "round-trip re-rounds (lossy) and pays full-precision bandwidth "
+        "on the path int8 exists to slim",
+        "a declared quantize site vanished — part of the cache append "
+        "is being written full-precision (or not at all); the quantized "
+        "layout and the program no longer agree",
+    )
+    diff(
+        "from_int8", counts["from_int8"], "dequantize",
+        "an int8 tensor is being materialised wide somewhere beyond the "
+        "declared reads — full-precision bytes moving on the "
+        "bandwidth-bound path",
+        "a declared dequantize site vanished — a consumer stopped "
+        "reading int8 (e.g. a weight silently left the quantized set), "
+        "so the path is running full precision while the quality "
+        "budgets trivially pass",
+    )
+    return findings, counts
+
+
 def check_hazards(summary: JaxprSummary) -> list[Finding]:
     """Host-sync and recompilation hazards visible in the jaxpr."""
     findings: list[Finding] = []
@@ -275,6 +354,7 @@ def audit_program(
     donation_strict: bool = False,
     compute_dtype: str | None = None,
     allowed_f32_dots: int = 0,
+    q8_cast_budget: dict[str, int] | None = None,
     checks: tuple[str, ...] = ALL_CHECKS,
     vma_allow: dict[str, str] | None = None,
 ) -> AuditReport:
@@ -291,6 +371,10 @@ def audit_program(
     (ModelConfig.dtype); dtype checks only engage for low-precision
     programs. ``donation_strict``: partial donation aliasing is an error
     (see check_donation — the serving-engine cache contract).
+    ``q8_cast_budget``: {"to_int8": n, "from_int8": m} — a quantized
+    program's declared cast inventory; extra converts in either
+    direction are errors (check_q8_casts — a silent f32 round-trip on
+    the int8 path).
     ``vma_allow``: {finding code: reason} — downgrade the named vma
     findings to info with the reason attached (the audit-level analogue of
     a repolint allow-comment: the decision stays visible in the report).
@@ -453,6 +537,14 @@ def audit_program(
                     allowed_f32_dots=allowed_f32_dots,
                 )
             )
+        if "dtype" in checks and q8_cast_budget is not None:
+            q8_findings, q8_counts = check_q8_casts(
+                summary, q8_cast_budget
+            )
+            report.extend(q8_findings)
+            report.summary["q8_casts"] = {
+                **q8_counts, "budget": dict(q8_cast_budget),
+            }
         if "hazards" in checks:
             report.extend(check_hazards(summary))
 

@@ -209,6 +209,8 @@ def _build_batched_engine(
     mesh_cfg: MeshConfig | None = None,
     budget: CollectiveBudget | None = NO_COLLECTIVES,
     budget_case: str | None = None,
+    weight_quant: str = "none",
+    audit_extra: dict | None = None,
 ):
     """A slot-batched serving program (serving/engine.BatchedDecodeEngine):
     the EXACT jitted prefill / decode_step the scheduler dispatches. All
@@ -229,7 +231,7 @@ def _build_batched_engine(
     params = get_model(cfg).init(domain_key(42, "init"), cfg)
     engine = BatchedDecodeEngine(
         cfg, slots=4, max_len=16, buckets=BucketSpec((8, 16)),
-        mesh_cfg=mesh_cfg,
+        mesh_cfg=mesh_cfg, weight_quant=weight_quant,
     )
     fn = engine.program(kind)
     args = engine.example_args(kind, engine._place_params(params))
@@ -239,12 +241,16 @@ def _build_batched_engine(
         "compute_dtype": cfg.dtype,
         "donate_argnums": (engine.CACHE_ARGNUM[kind],),
         "donation_strict": True,
+        **(audit_extra or {}),
     }
 
 
 def _build_paged_engine(
     kind: str,
     budget: CollectiveBudget | None = NO_COLLECTIVES,
+    kv_quant: str = "none",
+    weight_quant: str = "none",
+    audit_extra: dict | None = None,
 ):
     """A paged slot-batched serving program
     (serving/engine.PagedBatchedDecodeEngine): the EXACT jitted chunked
@@ -264,7 +270,7 @@ def _build_paged_engine(
     params = get_model(cfg).init(domain_key(42, "init"), cfg)
     engine = PagedBatchedDecodeEngine(
         cfg, slots=4, max_len=16, page_size=8, pool_pages=8,
-        prefill_chunk=8,
+        prefill_chunk=8, kv_quant=kv_quant, weight_quant=weight_quant,
     )
     fn = engine.program(kind)
     args = engine.example_args(kind, engine._place_params(params))
@@ -272,6 +278,7 @@ def _build_paged_engine(
         "compute_dtype": cfg.dtype,
         "donate_argnums": (engine.CACHE_ARGNUM[kind],),
         "donation_strict": True,
+        **(audit_extra or {}),
     }
 
 
@@ -552,6 +559,67 @@ def registered_cases() -> dict[str, AuditCase]:
             "page pool): single device, any collective is a bug",
             1,
             lambda: _build_paged_engine("decode_step"),
+        ),
+        # Quantized serving programs: int8 KV pages (quantize-on-append,
+        # dequant-on-read) + int8 weight-only projections. Same strict
+        # donation + NO_COLLECTIVES contracts as the f32 paged cases,
+        # PLUS the q8 cast budget: the program's int8 convert inventory
+        # is pinned to its declared quantize/dequantize sites (2
+        # appends; 2 KV reads + 4 gpt2 projection upcasts), so a silent
+        # f32 round-trip on the quantized path FAILS the audit
+        # (check_q8_casts; negative-tested in tests/test_quant.py).
+        AuditCase(
+            "decode_paged_prefill_q8",
+            "int8 paged chunked prefill (quantize-on-append KV pages + "
+            "weight-only int8 projections, donated int8 pool + scale "
+            "pools): strict donation, no collectives, pinned q8 casts",
+            1,
+            lambda: _build_paged_engine(
+                "prefill", kv_quant="int8", weight_quant="int8",
+                audit_extra={
+                    "q8_cast_budget": {"to_int8": 2, "from_int8": 6},
+                },
+            ),
+        ),
+        AuditCase(
+            "decode_paged_step_q8",
+            "int8 paged decode step (dequant-on-read block-table "
+            "attention + weight-only int8 projections): strict "
+            "donation, no collectives, pinned q8 casts",
+            1,
+            lambda: _build_paged_engine(
+                "decode_step", kv_quant="int8", weight_quant="int8",
+                audit_extra={
+                    "q8_cast_budget": {"to_int8": 2, "from_int8": 6},
+                },
+            ),
+        ),
+        AuditCase(
+            "decode_batched_step_tp_q8",
+            "slot-batched decode step over tensor=4 with int8 weight-"
+            "only projections: the per-channel scale is applied to the "
+            "local partial BEFORE the psum, so the pinned Megatron "
+            "all-reduce count (2) must survive quantization unchanged",
+            4,
+            lambda: _build_batched_engine(
+                "decode_step",
+                mesh_cfg=MeshConfig(tensor=4, strategy="no_shard"),
+                weight_quant="int8",
+                budget=CollectiveBudget(
+                    required={"all-reduce"},
+                    forbidden={
+                        "all-gather", "reduce-scatter", "all-to-all",
+                        "collective-permute",
+                    },
+                    note="int8 weights must not move the Megatron "
+                         "collective structure: scales are linear "
+                         "factors applied pre-psum",
+                ),
+                budget_case="decode_batched_step_tp",
+                audit_extra={
+                    "q8_cast_budget": {"to_int8": 0, "from_int8": 4},
+                },
+            ),
         ),
         # pjit twins of the explicit cases (parallel/api.py). Budgets per
         # _build_pjit's docstring: derived where the partitioner's op set

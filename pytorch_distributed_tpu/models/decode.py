@@ -81,34 +81,57 @@ def init_cache(
 
 def init_paged_cache(
     cfg: ModelConfig, pool_pages: int, page_size: int, dtype=None,
-    n_kv: int | None = None,
+    n_kv: int | None = None, kv_quant: str = "none",
 ) -> Cache:
     """Preallocate a PAGED [L, pool_pages, page_size, Hkv, D] key/value
     pool pair (serving/block_pool.py owns the host-side allocation; page
-    0 is the reserved scratch page). ``n_kv`` as in ``init_cache``."""
+    0 is the reserved scratch page). ``n_kv`` as in ``init_cache``.
+
+    ``kv_quant="int8"``: the value pools are int8 and two f32 scale
+    pools ``k_scale``/``v_scale`` of [L, pool_pages, page_size, Hkv]
+    ride alongside — one symmetric scale per written token per KV head
+    (ops/quant.py: per-token granularity is what keeps incremental page
+    writes sound), cutting a page's bytes to ~(D + 4)/(4D) of the f32
+    pool."""
+    if kv_quant not in ("none", "int8"):
+        raise ValueError(
+            f"kv_quant must be 'none' or 'int8', got {kv_quant!r}"
+        )
     dtype = jnp.dtype(dtype or cfg.dtype)
     shape = (
         cfg.n_layer, pool_pages, page_size, n_kv or cfg.kv_heads,
         cfg.head_dim,
     )
+    if kv_quant == "int8":
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.ones(shape[:-1], jnp.float32),
+            "v_scale": jnp.ones(shape[:-1], jnp.float32),
+        }
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
 def gather_pages(cache_layer: jax.Array, block_tables: jax.Array):
-    """[P, page, Hkv, D] pool + [B, n_pages] tables -> the [B, S, Hkv, D]
-    contiguous per-row view dense attention expects (S = n_pages * page).
+    """[P, page, ...] pool + [B, n_pages] tables -> the [B, S, ...]
+    contiguous per-row view dense attention expects (S = n_pages * page;
+    trailing dims pass through, so int8 value pools [P, page, Hkv, D]
+    and their scale pools [P, page, Hkv] gather through the same code).
     Unallocated table entries point at the scratch page — garbage the
     ``pos`` mask already excludes, exactly like a dense row's unwritten
     tail. This is the XLA fallback the CPU rig runs; the Pallas decode
     kernel (ops/paged_kernel.py) reads pages in place instead."""
     b, n_pages = block_tables.shape
-    page, hkv, d = cache_layer.shape[1:]
-    return cache_layer[block_tables].reshape(b, n_pages * page, hkv, d)
+    page = cache_layer.shape[1]
+    return cache_layer[block_tables].reshape(
+        (b, n_pages * page) + cache_layer.shape[2:]
+    )
 
 
-def _cached_attention(q, ck, cv, pos, block_tables=None,
-                      paged_impl="gather"):
-    """q [B, T, H, D] against the full cache [B, S, Hkv, D]; queries sit at
+def _cached_attention(q, kv, pos, block_tables=None,
+                      paged_impl="gather", kv_quant="none"):
+    """q [B, T, H, D] against the full cache ``kv`` ({"k", "v"} leaves
+    [B, S, Hkv, D]); queries sit at
     global positions pos..pos+T-1, keys j are valid iff j <= pos + i.
     ``pos`` is a scalar (every row at the same position — the single-request
     paths) or a [B] vector (slot-batched decode: each row carries its own
@@ -116,12 +139,20 @@ def _cached_attention(q, ck, cv, pos, block_tables=None,
     ever read — is independent of its neighbours).
 
     ``block_tables`` [B, n_pages] switches to the PAGED cache layout
-    (ck/cv are [P, page, Hkv, D] pools): the gather fallback materialises
+    (k/v are [P, page, Hkv, D] pools): the gather fallback materialises
     the per-row view and runs the identical masked math (bit-equal to the
     dense path wherever the valid positions hold the same values); for
     single-token decode, ``paged_impl`` of "kernel"/"kernel_interpret"
     dispatches the Pallas paged-attention kernel instead, which reads
-    pages in place and skips pages past each row's depth."""
+    pages in place and skips pages past each row's depth.
+
+    ``kv_quant="int8"`` (paged only): ``kv`` additionally carries
+    ``k_scale``/``v_scale`` pools; the gather path dequantizes the
+    gathered view (one int8->f32 convert per K and V — the audit's q8
+    cast budget counts them) and runs the identical masked math, the
+    kernel path dequantizes page blocks in VMEM (dequant-in-kernel —
+    HBM only ever moves int8 pages + scales)."""
+    ck, cv = kv["k"], kv["v"]
     if block_tables is not None and q.shape[1] == 1 and (
         paged_impl in ("kernel", "kernel_interpret")
     ):
@@ -129,14 +160,28 @@ def _cached_attention(q, ck, cv, pos, block_tables=None,
             paged_decode_attention,
         )
 
+        scales = (
+            (kv["k_scale"], kv["v_scale"]) if kv_quant == "int8"
+            else (None, None)
+        )
         out = paged_decode_attention(
             q[:, 0], ck, cv, block_tables, pos,
+            k_scales=scales[0], v_scales=scales[1],
             interpret=paged_impl == "kernel_interpret",
         )
         return out[:, None]
     if block_tables is not None:
         ck = gather_pages(ck, block_tables)
         cv = gather_pages(cv, block_tables)
+        if kv_quant == "int8":
+            from pytorch_distributed_tpu.ops.quant import dequantize_kv
+
+            ck = dequantize_kv(
+                ck, gather_pages(kv["k_scale"], block_tables), q.dtype
+            )
+            cv = dequantize_kv(
+                cv, gather_pages(kv["v_scale"], block_tables), q.dtype
+            )
     b, t, h, d = q.shape
     s, hkv = ck.shape[1], ck.shape[2]
     if hkv != h:
@@ -186,6 +231,32 @@ def _write(cache_layer, new, pos, block_tables=None):
     return jax.lax.dynamic_update_slice(cache_layer, new, (0, pos, 0, 0))
 
 
+def _write_kv(kv, k_new, v_new, pos, block_tables=None, kv_quant="none"):
+    """Insert this step's [B, T, Hkv, D] K/V into the per-layer cache
+    dict. ``kv_quant="int8"`` (paged only) QUANTIZES ON APPEND: the new
+    tokens' values are rounded to int8 with per-token/per-head scales
+    (ops/quant.quantize_kv — one f32->int8 convert each for K and V, the
+    audit-counted quantize sites) and the value + scale pools are
+    scattered through the same page indirection; already-written
+    positions are never touched, so appending can never re-quantize a
+    neighbour (the per-token-scale soundness argument)."""
+    if kv_quant == "int8":
+        from pytorch_distributed_tpu.ops.quant import quantize_kv
+
+        kq, ks = quantize_kv(k_new)
+        vq, vs = quantize_kv(v_new)
+        return {
+            "k": _write(kv["k"], kq, pos, block_tables),
+            "v": _write(kv["v"], vq, pos, block_tables),
+            "k_scale": _write(kv["k_scale"], ks, pos, block_tables),
+            "v_scale": _write(kv["v_scale"], vs, pos, block_tables),
+        }
+    return {
+        "k": _write(kv["k"], k_new, pos, block_tables),
+        "v": _write(kv["v"], v_new, pos, block_tables),
+    }
+
+
 def _moe_mlp(m, mlp_params, cfg, act, tensor_axis=None):
     """Routed MLP for decode: top-1/top-k routing is per-token and
     cache-free, so only the MLP call differs from training. Capacity is
@@ -209,52 +280,53 @@ def _moe_mlp(m, mlp_params, cfg, act, tensor_axis=None):
     return out
 
 
-def _gpt2_block(x, bp, ck, cv, pos, cfg, tensor_axis=None,
-                block_tables=None, paged_impl="gather"):
+def _gpt2_block(x, bp, kv, pos, cfg, tensor_axis=None,
+                block_tables=None, paged_impl="gather", kv_quant="none"):
     eps = cfg.layer_norm_epsilon
     b, t = x.shape[:2]
     a = layer_norm(x, bp["ln_1"], eps=eps)
     qkv = dense(a, bp["attn"]["c_attn"])  # [B, T, 3, H(/tp), D]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    ck = _write(ck, k, pos, block_tables)
-    cv = _write(cv, v, pos, block_tables)
+    kv = _write_kv(kv, k, v, pos, block_tables, kv_quant)
     a = _cached_attention(
-        q, ck, cv, pos, block_tables, paged_impl
+        q, kv, pos, block_tables, paged_impl, kv_quant
     ).reshape(b, t, -1)
     x = x + dense(a, bp["attn"]["c_proj"], tp_reduce_axis=tensor_axis)
     m = layer_norm(x, bp["ln_2"], eps=eps)
     act = activation(cfg.activation_function)
     if cfg.n_experts:
         m = _moe_mlp(m, bp["mlp"], cfg, act, tensor_axis)
-        return x + m, ck, cv
+        return x + m, kv
     m = act(dense(m, bp["mlp"]["c_fc"]))
-    return x + dense(m, bp["mlp"]["c_proj"], tp_reduce_axis=tensor_axis), ck, cv
+    return x + dense(m, bp["mlp"]["c_proj"], tp_reduce_axis=tensor_axis), kv
 
 
-def _llama_block(x, bp, ck, cv, pos, cfg, cos, sin, tensor_axis=None,
-                 block_tables=None, paged_impl="gather"):
+def _llama_block(x, bp, kv, pos, cfg, cos, sin, tensor_axis=None,
+                 block_tables=None, paged_impl="gather", kv_quant="none"):
+    from pytorch_distributed_tpu.ops.quant import qdot
     from pytorch_distributed_tpu.ops.tp import tp_reduce
 
     eps = cfg.layer_norm_epsilon
     b, t = x.shape[:2]
     d = cfg.head_dim
     a = rms_norm(x, bp["ln_attn"], eps=eps)
-    q = apply_rope((a @ bp["attn"]["wq"].astype(a.dtype)).reshape(b, t, -1, d), cos, sin)
-    k = apply_rope((a @ bp["attn"]["wk"].astype(a.dtype)).reshape(b, t, -1, d), cos, sin)
-    v = (a @ bp["attn"]["wv"].astype(a.dtype)).reshape(b, t, -1, d)
-    ck = _write(ck, k, pos, block_tables)
-    cv = _write(cv, v, pos, block_tables)
+    # qdot == `a @ w.astype(a.dtype)` for plain weights (bit-identical
+    # dot_general) and the int8 weight-only matmul for quantized ones.
+    q = apply_rope(qdot(a, bp["attn"]["wq"]).reshape(b, t, -1, d), cos, sin)
+    k = apply_rope(qdot(a, bp["attn"]["wk"]).reshape(b, t, -1, d), cos, sin)
+    v = qdot(a, bp["attn"]["wv"]).reshape(b, t, -1, d)
+    kv = _write_kv(kv, k, v, pos, block_tables, kv_quant)
     a = _cached_attention(
-        q, ck, cv, pos, block_tables, paged_impl
+        q, kv, pos, block_tables, paged_impl, kv_quant
     ).reshape(b, t, -1)
-    x = x + tp_reduce(a @ bp["attn"]["wo"].astype(a.dtype), tensor_axis)
+    x = x + tp_reduce(qdot(a, bp["attn"]["wo"]), tensor_axis)
     m = rms_norm(x, bp["ln_mlp"], eps=eps)
     if cfg.n_experts:
-        return x + _moe_mlp(m, bp["mlp"], cfg, jax.nn.silu, tensor_axis), ck, cv
-    gate = jax.nn.silu(m @ bp["mlp"]["gate"].astype(m.dtype))
-    up = m @ bp["mlp"]["up"].astype(m.dtype)
-    down = (gate * up) @ bp["mlp"]["down"].astype(m.dtype)
-    return x + tp_reduce(down, tensor_axis), ck, cv
+        return x + _moe_mlp(m, bp["mlp"], cfg, jax.nn.silu, tensor_axis), kv
+    gate = jax.nn.silu(qdot(m, bp["mlp"]["gate"]))
+    up = qdot(m, bp["mlp"]["up"])
+    down = qdot(gate * up, bp["mlp"]["down"])
+    return x + tp_reduce(down, tensor_axis), kv
 
 
 def forward(
@@ -269,6 +341,7 @@ def forward(
     prefetch_buffers: int = 0,
     block_tables: jax.Array | None = None,
     paged_impl: str = "gather",
+    kv_quant: str = "none",
 ) -> tuple[jax.Array, Cache]:
     """Run T tokens at positions pos..pos+T-1. Returns ([B, T, V] logits,
     updated cache). MoE configs route each token through the expert MLPs
@@ -312,6 +385,16 @@ def forward(
             "paged decode (block_tables) requires a per-row [B] pos "
             "vector — every paged row owns its own position"
         )
+    if kv_quant not in ("none", "int8"):
+        raise ValueError(
+            f"kv_quant must be 'none' or 'int8', got {kv_quant!r}"
+        )
+    if kv_quant != "none" and block_tables is None:
+        raise ValueError(
+            "kv_quant requires the paged cache layout (block_tables): "
+            "dense caches stay full precision — quantized pages are the "
+            "block-pool feature (init_paged_cache(kv_quant=...))"
+        )
 
     if cfg.family == "gpt2":
         if per_row:
@@ -323,6 +406,7 @@ def forward(
         block = partial(
             _gpt2_block, cfg=cfg, tensor_axis=tensor_axis,
             block_tables=block_tables, paged_impl=paged_impl,
+            kv_quant=kv_quant,
         )
     elif cfg.family == "llama":
         x = params["wte"][input_ids].astype(dtype)
@@ -334,20 +418,23 @@ def forward(
             _llama_block, cfg=cfg, cos=cos, sin=sin,
             tensor_axis=tensor_axis,
             block_tables=block_tables, paged_impl=paged_impl,
+            kv_quant=kv_quant,
         )
     else:
         raise KeyError(f"unknown model family {cfg.family!r}")
 
-    def block_body(x, bp, extra):
-        ck_l, cv_l = extra
-        x, ck_l, cv_l = block(x, bp, ck_l, cv_l, pos)
-        return x, (ck_l, cv_l)
+    def block_body(x, bp, kv_l):
+        # ``kv_l`` is one layer's cache-leaf dict (k/v, plus the scale
+        # pools when quantized) — scan_layers slices/stacks the whole
+        # dict, so the leaf set is the cache layout's business, not the
+        # scan's.
+        return block(x, bp, kv_l, pos)
 
-    x, (ck, cv) = scan_layers(
+    x, kv = scan_layers(
         block_body,
         x,
         params["blocks"],
-        extras=(cache["k"], cache["v"]),
+        extras=cache,
         remat_mode="none",
         block_transform=block_transform,
         prefetch_buffers=prefetch_buffers,
@@ -357,7 +444,7 @@ def forward(
     from pytorch_distributed_tpu.models import get_model
 
     logits = get_model(cfg).head(params, x, cfg)
-    return logits, {"k": ck, "v": cv}
+    return logits, kv
 
 
 # -- sampling --------------------------------------------------------------

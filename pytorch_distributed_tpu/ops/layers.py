@@ -29,13 +29,18 @@ def dense(
     row-parallel over — the kernel's input dim is sharded, each shard
     computes a partial sum, and the psum (ops/tp.tp_reduce) runs BEFORE the
     (replicated) bias is added so the bias is counted once.
+
+    A quantized kernel (ops/quant.quantize_weight dict: int8 values +
+    per-out-channel f32 scale) runs through the same ``ops.quant.qdot``
+    the llama raw matmuls use — upcast in-register, scale applied to
+    the local output BEFORE the tp psum (the scale is a linear factor,
+    so reducing scaled partials equals scaling the reduction and the
+    pinned TP all-reduce counts survive weight quantization by
+    construction).
     """
-    kernel = params["kernel"].astype(x.dtype)
-    y = jax.lax.dot_general(
-        x, kernel,
-        (((x.ndim - 1,), (0,)), ((), ())),
-        precision=precision,
-    )
+    from pytorch_distributed_tpu.ops.quant import qdot
+
+    y = qdot(x, params["kernel"], precision=precision)
     if tp_reduce_axis is not None:
         y = tp_reduce(y, tp_reduce_axis)
     bias = params.get("bias")

@@ -111,6 +111,72 @@ def _paged_kernel(
         ).astype(o_ref.dtype)
 
 
+def _paged_kernel_q8(
+    tables_ref,  # [B, n_pages] int32 (scalar prefetch)
+    lens_ref,  # [B] int32 (scalar prefetch): row's query position
+    q_ref,  # [1, group, D]
+    k_ref,  # [1, page, 1, D] int8 — the page tables_ref[b, i], head h
+    v_ref,  # [1, page, 1, D] int8
+    ks_ref,  # [1, page, 1] f32 per-token K scales for the same page/head
+    vs_ref,  # [1, page, 1] f32
+    o_ref,  # [1, group, D]
+    acc_sc,  # [group, D] f32
+    m_sc,  # [group, 1] f32
+    l_sc,  # [group, 1] f32
+    *,
+    page: int,
+    n_pages: int,
+    scale: float,
+):
+    """The int8 twin of ``_paged_kernel``: identical online-softmax
+    structure, but the page DMA moves INT8 K/V blocks plus their
+    per-token f32 scales, and dequantization happens in VMEM right
+    before the dot — HBM traffic for a page drops to (D + 4)/(4D) of
+    the f32 kernel's. Numerics past the dequant are the f32 kernel's
+    exactly (same accumulator dtypes, same masking), so quantized-vs-
+    gather equivalence is pinned the same way (tests/test_quant.py)."""
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc[:])
+        m_sc[:] = jnp.full_like(m_sc[:], NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc[:])
+
+    length = lens_ref[b]
+
+    @pl.when(i * page <= length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [group, D]
+        # Dequant-in-kernel: int8 page block * per-token scale column.
+        kb = k_ref[0, :, 0, :].astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+        vb = v_ref[0, :, 0, :].astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [group, page]
+        kpos = i * page + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(kpos <= length, s, NEG_INF)
+        m_new = jnp.maximum(m_sc[:], jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_sc[:] - m_new)
+        l_sc[:] = l_sc[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[:] = acc_sc[:] * corr + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_sc[:] = m_new
+
+    @pl.when(i == n_pages - 1)
+    def _emit():
+        o_ref[0] = (
+            acc_sc[:] / jnp.maximum(l_sc[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
 # repolint: allow(jit-donation-decision) — functional attention op: the
 # K/V pages belong to the serving engine's donated cache (aliased at the
 # PROGRAM boundary, not here) and q is read by the caller's residual.
@@ -158,25 +224,95 @@ def _paged_call(q, k_pages, v_pages, block_tables, lengths, interpret):
     )(block_tables, lengths, q, k_pages, v_pages)
 
 
+# repolint: allow(jit-donation-decision) — functional attention op, same
+# aliasing story as _paged_call (the pool is donated at the engine
+# program boundary, never here).
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _paged_call_q8(q, k_pages, v_pages, k_scales, v_scales,
+                   block_tables, lengths, interpret):
+    b, h, d = q.shape
+    n_pages = block_tables.shape[1]
+    page, hkv = k_pages.shape[1], k_pages.shape[2]
+    group = h // hkv
+    kernel = functools.partial(
+        _paged_kernel_q8,
+        page=page, n_pages=n_pages, scale=1.0 / (d**0.5),
+    )
+    page_spec = pl.BlockSpec(
+        (1, page, 1, d),
+        lambda bi, hi, i, tables, lens: (tables[bi, i], 0, hi, 0),
+    )
+    scale_spec = pl.BlockSpec(
+        (1, page, 1),
+        lambda bi, hi, i, tables, lens: (tables[bi, i], 0, hi),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv, n_pages),
+        in_specs=[
+            pl.BlockSpec(
+                (1, group, d), lambda bi, hi, i, tables, lens: (bi, hi, 0)
+            ),
+            page_spec,
+            page_spec,
+            scale_spec,
+            scale_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, group, d), lambda bi, hi, i, tables, lens: (bi, hi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+        **_compiler_params(),
+    )(block_tables, lengths, q, k_pages, v_pages, k_scales, v_scales)
+
+
 def paged_decode_attention(
     q: jax.Array,  # [B, H, D] — ONE query token per row
-    k_pages: jax.Array,  # [P, page, Hkv, D]
+    k_pages: jax.Array,  # [P, page, Hkv, D] (int8 when quantized)
     v_pages: jax.Array,  # [P, page, Hkv, D]
     block_tables: jax.Array,  # [B, n_pages] int32 page ids
     lengths: jax.Array,  # [B] int32: the row's position (keys <= it valid)
     *,
+    k_scales: jax.Array | None = None,  # [P, page, Hkv] f32 (int8 pages)
+    v_scales: jax.Array | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Paged single-query attention, [B, H, D] -> [B, H, D]. ``lengths``
     is each row's query position: key j is attended iff j <= lengths[b]
     (the dense decode-step mask at T=1). ``interpret=None`` picks the
-    compiled kernel on TPU and interpreter mode elsewhere."""
+    compiled kernel on TPU and interpreter mode elsewhere.
+
+    ``k_scales``/``v_scales`` switch to the int8 kernel: pages are int8
+    with per-token/per-head f32 scales and dequantization happens in
+    VMEM (the bandwidth-bound read moves quarter-width pages)."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     h, hkv = q.shape[1], k_pages.shape[2]
     if h % hkv:
         raise ValueError(
             f"query heads {h} must be a multiple of kv heads {hkv}"
+        )
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError(
+            "k_scales and v_scales must be given together (int8 pages) "
+            "or both omitted (full-precision pages)"
+        )
+    if k_scales is not None:
+        return _paged_call_q8(
+            q, k_pages, v_pages, k_scales, v_scales,
+            jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            bool(interpret),
         )
     return _paged_call(
         q, k_pages, v_pages,
@@ -187,17 +323,24 @@ def paged_decode_attention(
 
 
 def paged_decode_attention_reference(
-    q, k_pages, v_pages, block_tables, lengths
+    q, k_pages, v_pages, block_tables, lengths,
+    k_scales=None, v_scales=None,
 ) -> jax.Array:
-    """Pure-XLA reference: gather the per-row page view and run the
-    dense masked-softmax math (models/decode._cached_attention's paged
-    gather branch, restated at the T=1 shape) — what the kernel is
-    equivalence-tested against."""
+    """Pure-XLA reference: gather the per-row page view (dequantizing it
+    when scale pools are given) and run the dense masked-softmax math
+    (models/decode._cached_attention's paged gather branch, restated at
+    the T=1 shape) — what the kernel is equivalence-tested against."""
     from pytorch_distributed_tpu.models.decode import gather_pages
 
     b, h, d = q.shape
-    ck = gather_pages(k_pages, jnp.asarray(block_tables, jnp.int32))
-    cv = gather_pages(v_pages, jnp.asarray(block_tables, jnp.int32))
+    tables = jnp.asarray(block_tables, jnp.int32)
+    ck = gather_pages(k_pages, tables)
+    cv = gather_pages(v_pages, tables)
+    if k_scales is not None:
+        from pytorch_distributed_tpu.ops.quant import dequantize_kv
+
+        ck = dequantize_kv(ck, gather_pages(k_scales, tables), q.dtype)
+        cv = dequantize_kv(cv, gather_pages(v_scales, tables), q.dtype)
     s = ck.shape[1]
     hkv = ck.shape[2]
     if hkv != h:

@@ -85,6 +85,7 @@ import numpy as np
 
 from pytorch_distributed_tpu.config import MeshConfig, ModelConfig
 from pytorch_distributed_tpu.models import decode
+from pytorch_distributed_tpu.ops.quant import quantize_decode_params
 from pytorch_distributed_tpu.serving.lifecycle import (
     ABORTED,
     DONE,
@@ -100,6 +101,49 @@ from pytorch_distributed_tpu.utils.logging import log_event
 
 _PROGRAM_KINDS = ("prefill", "decode_run", "decode_step")
 _BATCHED_PROGRAM_KINDS = ("prefill", "decode_step")
+
+
+def _kv_bytes_per_position(cfg: ModelConfig, kv_quant: str = "none") -> int:
+    """K+V bytes one GLOBAL cache position costs across all layers (TP
+    divides the head dim across shards, so the global figure is the
+    comparable one either way). int8 pages carry one f32 scale per
+    token per KV head next to the values (ops/quant.quantize_kv), so a
+    quantized position costs head_dim + 4 bytes per head instead of
+    head_dim x itemsize."""
+    if kv_quant == "int8":
+        return cfg.n_layer * 2 * cfg.kv_heads * (cfg.head_dim + 4)
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return cfg.n_layer * 2 * cfg.kv_heads * cfg.head_dim * itemsize
+
+
+def _check_quant_arg(name: str, value: str) -> str:
+    if value not in ("none", "int8"):
+        raise ValueError(
+            f"{name} must be 'none' or 'int8', got {value!r}"
+        )
+    return value
+
+
+def _quantized_mesh_specs(cfg: ModelConfig, mesh, p_specs):
+    """(quantized spec tree, quantized NamedSharding tree) for a
+    weight-quantized decode params tree: kernel specs ride ``q8``,
+    scale specs drop the contracting dim (ops/quant.quantized_param_specs
+    — column-parallel scales shard with their channels, row-parallel
+    scales replicate)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.ops.quant import quantized_param_specs
+
+    abstract = jax.eval_shape(
+        lambda k: get_model(cfg).init(k, cfg), jax.random.key(0)
+    )
+    q_specs = quantized_param_specs(p_specs, abstract)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), q_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return q_specs, shardings
 
 
 def _reject_tp_zero3_mix(mesh_cfg: MeshConfig | None, entry: str) -> None:
@@ -206,6 +250,7 @@ class DecodeEngine:
         pool_caches: bool = True,
         pool_max_entries: int = 8,
         nan_guard: bool = True,
+        weight_quant: str = "none",
     ) -> None:
         if max_len > cfg.n_ctx:
             raise ValueError(
@@ -222,10 +267,35 @@ class DecodeEngine:
         self.mode, self.mesh_cfg, self._n_kv, self._prefetch_buffers = (
             _select_mode(cfg, mesh_cfg, entry="DecodeEngine")
         )
+        self.weight_quant = _check_quant_arg("weight_quant", weight_quant)
+        if self.weight_quant != "none" and self.mode == "zero3":
+            raise NotImplementedError(
+                "weight_quant with ZeRO-3 decode is future surface: the "
+                "windowed layer gathers move full-precision shards and "
+                "re-splitting int8+scale leaves through the auto "
+                "partitioner is unproven — serve quantized weights from "
+                "plain or tensor-only meshes"
+            )
+        if self.weight_quant != "none" and cfg.n_experts:
+            raise NotImplementedError(
+                "weight_quant does not cover MoE expert stacks (routed "
+                "expert weights need per-expert calibration surface) — "
+                "quantized decode serves dense gpt2/llama configs"
+            )
         if self.mode != "plain":
             (
                 self._mesh, self._p_specs, self._param_shardings
             ) = decode._mesh_param_shardings(cfg, self.mesh_cfg)
+            if self.weight_quant != "none":
+                self._p_specs, self._param_shardings = (
+                    _quantized_mesh_specs(cfg, self._mesh, self._p_specs)
+                )
+        # (source tree, prepared tree): weight quantization runs ONCE per
+        # params tree (identity memo), not once per request.
+        self._prepared: tuple[Any, Any] | None = None
+        # Pool HBM high-water mark (pooled + the in-flight buffer at the
+        # moment it is taken) — cache_hbm_bytes' peak figure.
+        self._peak_cache_bytes = 0
         # (kind, sampled) -> jitted program. Prefill additionally
         # specialises per bucket shape through jit's own shape cache, so
         # compile_count() reads len(buckets)-many entries off ONE program.
@@ -279,6 +349,7 @@ class DecodeEngine:
             "free_pages": None,
             "pages_in_use": None,
             "prefix_hit_rate": None,
+            "kv_quant": "none",
             "counters": dict(self.counters),
         }
 
@@ -287,6 +358,7 @@ class DecodeEngine:
     def new_cache(self, batch: int) -> decode.Cache:
         """Freshly-zeroed cache placed for this engine's mode (the pool
         bypasses this after the first request per batch size)."""
+        self._bump_cache_peak(batch)
         if self.mode == "tp":
             # Global [L, B, S, Hkv, D] array sharded over the head dim:
             # each shard holds its LOCAL kv heads, matching the local
@@ -297,8 +369,35 @@ class DecodeEngine:
             self.cfg, batch, self.max_len, n_kv=self._n_kv
         )
 
+    def _cache_bytes(self, batch: int) -> int:
+        return batch * self.max_len * _kv_bytes_per_position(self.cfg)
+
+    def _bump_cache_peak(self, taken_batch: int | None = None) -> None:
+        live = sum(self._cache_bytes(b) for b in self._cache_pool)
+        if taken_batch is not None:
+            live += self._cache_bytes(taken_batch)
+        if live > self._peak_cache_bytes:
+            self._peak_cache_bytes = live
+
+    def cache_hbm_bytes(self) -> dict[str, int]:
+        """Pooled KV-cache HBM: ``allocated`` = the buffers currently
+        retained by the LRU pool, ``peak_in_use`` = the high-water mark
+        of pooled + in-flight bytes — the serial engine's row of the
+        figure every serving bench leg reports (the batched/paged
+        engines' slots x max_len / pool numbers are the comparison)."""
+        return {
+            "allocated": sum(
+                self._cache_bytes(b) for b in self._cache_pool
+            ),
+            "peak_in_use": self._peak_cache_bytes,
+        }
+
     def _take_cache(self, batch: int) -> decode.Cache:
-        return self._cache_pool.pop(batch, None) or self.new_cache(batch)
+        pooled = self._cache_pool.pop(batch, None)
+        if pooled is not None:
+            self._bump_cache_peak(batch)
+            return pooled
+        return self.new_cache(batch)
 
     def _return_cache(self, batch: int, cache: decode.Cache) -> None:
         if not self._pool_caches:
@@ -466,6 +565,16 @@ class DecodeEngine:
         return prog
 
     def _place_params(self, params):
+        if self.weight_quant != "none":
+            # Quantize ONCE per params tree (identity memo — "weights
+            # quantized at engine build", with params staying call
+            # arguments), then place the int8+scale tree.
+            if self._prepared is None or self._prepared[0] is not params:
+                q = quantize_decode_params(params)
+                if self.mode != "plain":
+                    q = jax.device_put(q, self._param_shardings)
+                self._prepared = (params, q)
+            return self._prepared[1]
         if self.mode == "plain":
             return params
         # No-op when already placed, so repeat calls pay nothing.
@@ -856,6 +965,7 @@ class BatchedDecodeEngine:
         retry_backoff_s: float = 0.05,
         clock=None,
         sleep=None,
+        weight_quant: str = "none",
     ) -> None:
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -898,10 +1008,15 @@ class BatchedDecodeEngine:
         self.mode, self.mesh_cfg, self._n_kv, _ = _select_mode(
             cfg, mesh_cfg, entry="BatchedDecodeEngine", allow_zero3=False
         )
+        self.weight_quant = _check_quant_arg("weight_quant", weight_quant)
         if self.mode == "tp":
             (
                 self._mesh, self._p_specs, self._param_shardings
             ) = decode._mesh_param_shardings(cfg, self.mesh_cfg)
+            if self.weight_quant != "none":
+                self._p_specs, self._param_shardings = (
+                    _quantized_mesh_specs(cfg, self._mesh, self._p_specs)
+                )
         self._programs: dict[str, Any] = {}
         # ONE cache for the engine's whole life, donated through every
         # dispatch — HBM is bounded at exactly one (slots, max_len) cache
@@ -1078,12 +1193,17 @@ class BatchedDecodeEngine:
         return prog
 
     def _place_params(self, params):
-        if self.mode == "plain":
+        if self.mode == "plain" and self.weight_quant == "none":
             return params
         if self._placed is None or self._placed[0] is not params:
-            self._placed = (
-                params, jax.device_put(params, self._param_shardings)
+            prepared = (
+                quantize_decode_params(params)
+                if self.weight_quant != "none"
+                else params
             )
+            if self.mode != "plain":
+                prepared = jax.device_put(prepared, self._param_shardings)
+            self._placed = (params, prepared)
         return self._placed[1]
 
     # -- request API -------------------------------------------------------
@@ -1858,6 +1978,7 @@ class BatchedDecodeEngine:
             "free_pages": None,
             "pages_in_use": None,
             "prefix_hit_rate": None,
+            "kv_quant": "none",
             "counters": dict(self.counters),
         }
 
@@ -1870,11 +1991,9 @@ class BatchedDecodeEngine:
 
     def _bytes_per_position(self) -> int:
         """K+V bytes one GLOBAL cache position costs across all layers
-        (TP divides the positions' head dim across shards, so the global
-        figure is the comparable one either way)."""
-        cfg = self.cfg
-        itemsize = jnp.dtype(cfg.dtype).itemsize
-        return cfg.n_layer * 2 * cfg.kv_heads * cfg.head_dim * itemsize
+        (see ``_kv_bytes_per_position``; the paged subclass switches the
+        figure when its pool is quantized)."""
+        return _kv_bytes_per_position(self.cfg)
 
     def cache_hbm_bytes(self) -> dict[str, int]:
         """Allocated KV-cache HBM (the dense engine preallocates
@@ -2040,6 +2159,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         pool_pages: int | None = None,
         prefill_chunk: int | None = None,
         paged_attention: str = "gather",
+        kv_quant: str = "none",
         mesh_cfg: MeshConfig | None = None,
         **kw,
     ) -> None:
@@ -2107,28 +2227,60 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 f"'kernel_interpret', got {paged_attention!r}"
             )
         self._paged_impl = paged_attention
+        self.kv_quant = _check_quant_arg("kv_quant", kv_quant)
         self.counters["preemptions"] = 0
+        log_event(
+            "pool_build",
+            quant=self.kv_quant,
+            pool_pages=self.pool_pages,
+            page_size=self.page_size,
+            prefill_chunk=self.chunk,
+            slots=self.slots,
+            pool_hbm_bytes=(
+                self.pool_pages * self.page_size
+                * _kv_bytes_per_position(cfg, self.kv_quant)
+            ),
+        )
 
     # -- cache -------------------------------------------------------------
+
+    def _cache_pspec(self) -> dict:
+        """Per-leaf PartitionSpecs for the paged cache under TP: value
+        pools shard their Hkv dim; the int8 layout's scale pools shard
+        the same dim (their last — scales live with their heads)."""
+        from jax.sharding import PartitionSpec as P
+
+        spec = {
+            "k": P(None, None, None, "tensor", None),
+            "v": P(None, None, None, "tensor", None),
+        }
+        if self.kv_quant == "int8":
+            s = P(None, None, None, "tensor")
+            spec.update(k_scale=s, v_scale=s)
+        return spec
 
     def _new_cache(self) -> decode.Cache:
         self.counters["cache_allocs"] += 1
         if self.mode == "tp":
             full = decode.init_paged_cache(
-                self.cfg, self.pool_pages, self.page_size
+                self.cfg, self.pool_pages, self.page_size,
+                kv_quant=self.kv_quant,
             )
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            spec = P(None, None, None, "tensor", None)
             sharding = jax.tree.map(
                 lambda s: NamedSharding(self._mesh, s),
-                {"k": spec, "v": spec},
+                self._cache_pspec(),
                 is_leaf=lambda x: isinstance(x, P),
             )
             return jax.device_put(full, sharding)
         return decode.init_paged_cache(
-            self.cfg, self.pool_pages, self.page_size, n_kv=self._n_kv
+            self.cfg, self.pool_pages, self.page_size, n_kv=self._n_kv,
+            kv_quant=self.kv_quant,
         )
+
+    def _bytes_per_position(self) -> int:
+        return _kv_bytes_per_position(self.cfg, self.kv_quant)
 
     def cache_hbm_bytes(self) -> dict[str, int]:
         """Allocated pool HBM + the peak actually referenced by live
@@ -2151,19 +2303,31 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         out = super().stats()
         ps = self.pool.stats
         out.update(
+            # pool_pages is the EFFECTIVE page capacity: a quantized
+            # pool provisioned at byte-equal HBM holds ~4x the f32
+            # pages, and that real capacity is the router's page-
+            # pressure denominator (pages_in_use / pool_pages) — scoring
+            # in bytes would starve-exclude a quantized replica that
+            # still has page headroom (regression-pinned in
+            # tests/test_serving_quant.py).
             pool_pages=self.pool_pages,
             free_pages=self.pool.free_pages(),
             pages_in_use=self.pool.pages_in_use(),
             prefix_hit_rate=round(
                 ps["prefix_hits"] / max(1, ps["prefix_queries"]), 4
             ),
+            kv_quant=self.kv_quant,
         )
         return out
 
     # -- programs ----------------------------------------------------------
 
     def _forward_paged(self, params, ids, cache, pos, tables):
-        kwargs = {"block_tables": tables, "paged_impl": self._paged_impl}
+        kwargs = {
+            "block_tables": tables,
+            "paged_impl": self._paged_impl,
+            "kv_quant": self.kv_quant,
+        }
         if self.mode == "tp":
             kwargs["tensor_axis"] = "tensor"
         return decode.forward(params, ids, self.cfg, cache, pos, **kwargs)
@@ -2221,10 +2385,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
 
             from pytorch_distributed_tpu.utils.compat import shard_map
 
-            cache_spec = {
-                "k": P(None, None, None, "tensor", None),
-                "v": P(None, None, None, "tensor", None),
-            }
+            cache_spec = self._cache_pspec()
             specs = {
                 "prefill": (
                     self._p_specs, P(), P(), P(), P(), cache_spec,
@@ -2296,6 +2457,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             log_event(
                 "prefix_hit", rid=req.rid, cached_tokens=cached,
                 prompt_len=plen, t=round(self._clock(), 6),
+                quant=self.kv_quant if self.kv_quant != "none" else None,
             )
         pids = list(shared) + fresh
         table = np.zeros((self.max_pages,), np.int32)

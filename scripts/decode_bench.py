@@ -403,6 +403,18 @@ def bench_serving(args) -> list[dict]:
             ),
         }
 
+    # cache_hbm_bytes in the serial-engine leg too, so the pooled-cache
+    # HBM figure is comparable across ALL serving benches (the batched/
+    # paged legs already report theirs). The legacy per-call path has no
+    # engine to ask — its cache is jit-internal, re-allocated per call.
+    engine_row = _leg_row(
+        eng_compiles, eng_steady_compiles, eng_cold, eng_steady,
+        eng_warm, eng_times,
+    )
+    engine_row["cache_hbm_bytes"] = engine.cache_hbm_bytes()["allocated"]
+    engine_row["cache_hbm_bytes_peak_in_use"] = (
+        engine.cache_hbm_bytes()["peak_in_use"]
+    )
     rows.append({
         "leg": "serving_stream",
         "model": dict(
@@ -416,10 +428,7 @@ def bench_serving(args) -> list[dict]:
         "sampling_configs": len(configs),
         "steady_passes": args.repeats,
         "buckets": list(buckets.buckets),
-        "engine": _leg_row(
-            eng_compiles, eng_steady_compiles, eng_cold, eng_steady,
-            eng_warm, eng_times,
-        ),
+        "engine": engine_row,
         "legacy": _leg_row(
             leg_compiles, leg_steady_compiles, leg_cold, leg_steady,
             leg_warm, leg_times,
@@ -485,15 +494,20 @@ def bench_serving(args) -> list[dict]:
             )
             pp = zeng._place_params(params)
             cache = zeng.new_cache(zbatch)
-            tok, cache = zeng.program("prefill", True)(
+            # Engine programs return (tokens, nan-sentinel, cache) since
+            # the robustness PR; this leg drives them raw and ignores
+            # the sentinel (benching, not serving).
+            tok, _, cache = zeng.program("prefill", True)(
                 pp, zpadded, plen, cache, t, k, p, key
             )
             run = zeng.program("decode_run", True)
-            out, cache = run(pp, tok, cache, plen, znew, t, k, p, key)
+            out, _, cache = run(pp, tok, cache, plen, znew, t, k, p, key)
             jax.block_until_ready(out)  # compile + warm
             t0 = time.perf_counter()
             for _ in range(args.repeats):
-                out, cache = run(pp, tok, cache, plen, znew, t, k, p, key)
+                out, _, cache = run(
+                    pp, tok, cache, plen, znew, t, k, p, key
+                )
                 jax.block_until_ready(out)
             elapsed = time.perf_counter() - t0
             legs[prefetch] = dict(
@@ -508,7 +522,7 @@ def bench_serving(args) -> list[dict]:
                 with tempfile.TemporaryDirectory() as trace_dir:
                     with jax.profiler.trace(trace_dir):
                         for _ in range(zruns_per_trace):
-                            out, cache = run(
+                            out, _, cache = run(
                                 pp, tok, cache, plen, znew, t, k, p, key
                             )
                         jax.block_until_ready(out)
@@ -896,6 +910,313 @@ def bench_serving_paged(args) -> list[dict]:
     return [row]
 
 
+def bench_serving_quant(args) -> list[dict]:
+    """Quantized KV pages (+ optional int8 weight-only projections) vs
+    the f32 paged engine on the SAME seeded all-greedy shared-prefix
+    arrival stream — the ``--serving-paged --kv-quant int8`` leg. Three
+    engines, one schedule:
+
+    - ``f32``: the PR-8 paged engine at a page-pressured pool size
+      (preemptions expected — that is the pressure the capacity win
+      relieves);
+    - ``int8``: the same pool GEOMETRY quantized — page-pool HBM drops
+      to ~(D+4)/(4D) of f32 (reported as ``page_pool_hbm_ratio`` via
+      ``cache_hbm_bytes()``; vs a bf16 cache the same layout is ~0.56x),
+      throughput statistically unchanged on this rig;
+    - ``int8_equal_bytes``: the pool re-provisioned to the f32 leg's
+      BYTE budget — ~bpp_f32/bpp_int8 more pages, so the pressure
+      (preemptions, admission deferrals) melts and tok/s must be no
+      worse than f32 at equal pool bytes: the capacity win made real.
+
+    Quality is ASSERTED, not printed: teacher-forced greedy agreement
+    (both forwards over the f32 leg's served sequences, argmax compared
+    position-by-position — identical contexts, so pure quantization
+    error) and the relative logit MSE from the same probe must hold the
+    pinned ``ops.quant.Q8_QUALITY`` budgets, and steady-state compiles
+    must be ZERO on every leg — the CI smoke fails loudly on breach
+    (SystemExit), the same posture as the bit-equivalence pins. The
+    autoregressive prefix-match rate between the legs' actual outputs
+    rides the row unpinned (chaos-amplified on a random-init model —
+    see Q8_QUALITY)."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.models import decode, get_model
+    from pytorch_distributed_tpu.ops.quant import (
+        Q8_QUALITY,
+        argmax_agreement,
+        quantize_decode_params,
+        relative_logit_mse,
+        token_match_rate,
+    )
+    from pytorch_distributed_tpu.serving.engine import (
+        PagedBatchedDecodeEngine,
+        _kv_bytes_per_position,
+    )
+    from pytorch_distributed_tpu.serving.workload import (
+        exponential_arrivals,
+        request_stream,
+    )
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _serving_cfg(args.dryrun)
+    slots = 4 if args.dryrun else 8
+    max_new = 12 if args.dryrun else 32
+    max_len = 160 if args.dryrun else 384
+    page = 16
+    chunk = 16 if args.dryrun else 32
+    n_req = 16 if args.dryrun else 48
+    prefix_len = 48 if args.dryrun else 96
+    tail_max = (max_len - max_new - prefix_len) // 2
+    # A QUARTER of the dense-equivalent pool: the f32 leg runs genuinely
+    # page-pressured (preemptions/admission deferrals are the cost the
+    # quantized capacity removes — with a roomy pool both quant legs
+    # just tie f32 and the capacity claim is untested), while still
+    # >= one full-depth row so nothing rejects outright.
+    pool_pages = max(slots * max_len // (4 * page), max_len // page + 1)
+    bpp_f32 = _kv_bytes_per_position(cfg)
+    bpp_q8 = _kv_bytes_per_position(cfg, "int8")
+    pool_pages_eq = pool_pages * bpp_f32 // bpp_q8
+    seed = args.chaos_seed
+    params = get_model(cfg).init(domain_key(seed, "init"), cfg)
+    rng = np.random.default_rng(seed)
+
+    system_prefix = rng.integers(
+        0, cfg.vocab_size, (prefix_len,)
+    ).astype(np.int32)
+    # All-greedy stream: the token-match budget is a statement about the
+    # model's argmax under quantization noise, not about resampling.
+    requests = request_stream(
+        rng, n=n_req, vocab_size=cfg.vocab_size,
+        prompt_len=(4, tail_max - 1), max_new=max_new, key_seed=seed,
+        shared_prefix=system_prefix, sampling_cycle=(dict(),),
+    )
+
+    def make_engine(kv_quant, pages):
+        return PagedBatchedDecodeEngine(
+            cfg, slots=slots, max_len=max_len, page_size=page,
+            prefill_chunk=chunk, pool_pages=pages, kv_quant=kv_quant,
+            weight_quant=(
+                args.weight_quant if kv_quant != "none" else "none"
+            ),
+        )
+
+    # One arrival schedule, calibrated on a THROWAWAY f32 engine and
+    # offered at ~4x the serial drain rate: the pool comparison is only
+    # meaningful at SATURATION — under-offered load measures the
+    # arrival process, and the pressured f32 pool's preemption churn
+    # (each preemption re-prefills a whole row) is exactly the cost the
+    # quantized capacity removes. The probe must not touch a measured
+    # engine: serving the shared-prefix request would leave the f32
+    # leg's prefix cache warm (block_pool retains released prefix
+    # pages) and its preemption counter dirty while the int8 legs start
+    # cold — the three-way comparison would stand on unequal footing.
+    probe_eng = make_engine("none", pool_pages)
+    probe_eng.warmup(params)
+    t0 = time.perf_counter()
+    probe_eng.run(params, [requests[0]])
+    probe_eng.pop_result(0)
+    per_req_est = time.perf_counter() - t0
+    del probe_eng
+    mean_interarrival = per_req_est / (4 * slots)
+    arrivals = exponential_arrivals(rng, n_req, mean_interarrival)
+
+    engines = {
+        "f32": make_engine("none", pool_pages),
+        "int8": make_engine(args.kv_quant, pool_pages),
+        "int8_equal_bytes": make_engine(args.kv_quant, pool_pages_eq),
+    }
+    warm = {}
+    for name, eng in engines.items():
+        eng.warmup(params)
+        warm[name] = eng.compile_count()
+
+    def drive(eng):
+        clock = 0.0
+        pending = list(zip(arrivals, range(n_req)))
+        submitted: dict[int, float] = {}
+        rid_to_idx: dict[int, int] = {}
+        lat: dict[int, float] = {}
+        while pending or eng.has_work():
+            while pending and pending[0][0] <= clock:
+                arr, i = pending.pop(0)
+                rid = eng.submit(**requests[i])
+                submitted[rid] = arr
+                rid_to_idx[rid] = i
+            if not eng.has_work():
+                clock = pending[0][0]
+                continue
+            t0 = time.perf_counter()
+            done = eng.step(params)
+            clock += time.perf_counter() - t0
+            for rid in done:
+                lat[rid_to_idx[rid]] = clock - submitted[rid]
+        span = clock - arrivals[0]
+        results = {
+            rid_to_idx[rid]: eng.pop_result(rid)
+            for rid in list(eng.results)
+        }
+        return span, lat, results
+
+    runs = {name: drive(eng) for name, eng in engines.items()}
+    steady = {
+        name: engines[name].compile_count() - warm[name]
+        for name in engines
+    }
+
+    # Quality, measured between the int8 and f32 paths on the SAME
+    # stream. Two token metrics, one pinned:
+    # - TEACHER-FORCED greedy agreement (pinned): feed the f32 leg's
+    #   served sequences through both forwards in one batched probe and
+    #   compare argmax position-by-position over the generated region —
+    #   identical contexts, so this measures quantization error alone.
+    # - autoregressive prefix match (reported, unpinned): the engines'
+    #   actual outputs diverge geometrically once ONE near-tied argmax
+    #   flips (~0.98^max_new on a random-init model) — see
+    #   ops/quant.Q8_QUALITY for why that is a chaos statement, not a
+    #   quality one.
+    # The relative logit MSE (pinned) comes from the same probe logits.
+    import jax.numpy as jnp
+
+    gen = {
+        name: [
+            np.asarray(res[i].tokens)[len(requests[i]["prompt"]):]
+            for i in sorted(res)
+        ]
+        for name, (_, _, res) in runs.items()
+    }
+    prefix_match = token_match_rate(gen["f32"], gen["int8"])
+
+    probe_n = min(12, n_req)
+    seqs = [
+        np.concatenate(
+            [np.asarray(requests[i]["prompt"], np.int32), gen["f32"][i]]
+        )[:-1]
+        for i in range(probe_n)
+    ]
+    gen_starts = [len(requests[i]["prompt"]) - 1 for i in range(probe_n)]
+    t_max = max(len(s) for s in seqs)
+    batch = np.zeros((probe_n, t_max), np.int32)
+    for i, s in enumerate(seqs):
+        batch[i, : len(s)] = s
+    n_pp = -(-t_max // page)
+    ptab = (
+        1 + np.arange(probe_n * n_pp, dtype=np.int32)
+    ).reshape(probe_n, n_pp)
+    ppos = jnp.zeros((probe_n,), jnp.int32)
+    pool_probe = probe_n * n_pp + 1
+    cache_f = decode.init_paged_cache(cfg, pool_probe, page)
+    cache_q = decode.init_paged_cache(
+        cfg, pool_probe, page, kv_quant=args.kv_quant
+    )
+    logits_f, _ = decode.forward(
+        params, jnp.asarray(batch), cfg, cache_f, ppos,
+        block_tables=jnp.asarray(ptab),
+    )
+    qparams = (
+        quantize_decode_params(params)
+        if args.weight_quant != "none" else params
+    )
+    logits_q, _ = decode.forward(
+        qparams, jnp.asarray(batch), cfg, cache_q, ppos,
+        block_tables=jnp.asarray(ptab), kv_quant=args.kv_quant,
+    )
+    # Concatenate every row's generated-region logits and feed the
+    # CANONICAL metric definitions (ops/quant.py — the same functions
+    # the tests pin Q8_QUALITY with), so the CI gate and the tested
+    # contract can never measure different things.
+    lf, lq = np.asarray(logits_f), np.asarray(logits_q)
+    gen_f = np.concatenate([
+        lf[i, gen_starts[i]: len(s)] for i, s in enumerate(seqs)
+    ])
+    gen_q = np.concatenate([
+        lq[i, gen_starts[i]: len(s)] for i, s in enumerate(seqs)
+    ])
+    match_rate = argmax_agreement(gen_f, gen_q)
+    logit_mse = relative_logit_mse(gen_f, gen_q)
+
+    hbm = {
+        name: engines[name].cache_hbm_bytes() for name in engines
+    }
+    total_tokens = n_req * max_new
+
+    def _leg(name):
+        span, lat, _ = runs[name]
+        lat = list(lat.values())
+        return {
+            "kv_quant": engines[name].kv_quant,
+            "weight_quant": engines[name].weight_quant,
+            "pool_pages": engines[name].pool_pages,
+            "steady_tokens_per_sec": round(total_tokens / span, 1),
+            "p50_request_ms": round(_pct(lat, 0.50) * 1e3, 2),
+            "p99_request_ms": round(_pct(lat, 0.99) * 1e3, 2),
+            "observed_compile_count_steady": steady[name],
+            "cache_hbm_bytes": hbm[name]["allocated"],
+            "cache_hbm_bytes_peak_in_use": hbm[name]["peak_in_use"],
+            "preemptions": engines[name].counters["preemptions"],
+        }
+
+    row = {
+        "leg": "serving_quant_stream",
+        "model": dict(
+            n_embd=cfg.n_embd, n_layer=cfg.n_layer,
+            vocab_size=cfg.vocab_size,
+        ),
+        "slots": slots,
+        "max_new": max_new,
+        "max_len": max_len,
+        "page_size": page,
+        "prefill_chunk": chunk,
+        "requests": n_req,
+        "shared_prefix_tokens": prefix_len,
+        "seed": seed,
+        "sampling": "all-greedy (quality is an argmax statement)",
+        "mean_interarrival_ms": round(mean_interarrival * 1e3, 2),
+        "bytes_per_position": {"f32": bpp_f32, "int8": bpp_q8},
+        "f32": _leg("f32"),
+        "int8": _leg("int8"),
+        "int8_equal_bytes": _leg("int8_equal_bytes"),
+        "page_pool_hbm_ratio": round(
+            hbm["int8"]["allocated"] / hbm["f32"]["allocated"], 4
+        ),
+        "equal_bytes_speedup": round(
+            runs["f32"][0] / runs["int8_equal_bytes"][0], 3
+        ),
+        "quality": {
+            "greedy_token_match_rate": round(match_rate, 4),
+            "relative_logit_mse": float(f"{logit_mse:.3e}"),
+            "autoregressive_prefix_match_rate": round(prefix_match, 4),
+            "probe_requests": probe_n,
+            "budget": dict(Q8_QUALITY),
+        },
+        "platform": jax.devices()[0].platform,
+    }
+
+    # The contractual invariants — FAIL the run, don't just print.
+    failures = []
+    for name, count in steady.items():
+        if count != 0:
+            failures.append(
+                f"{name} leg leaked {count} steady-state compiles"
+            )
+    if match_rate < Q8_QUALITY["min_token_match_rate"]:
+        failures.append(
+            f"greedy token-match rate {match_rate:.4f} below the pinned "
+            f"budget {Q8_QUALITY['min_token_match_rate']}"
+        )
+    if logit_mse > Q8_QUALITY["max_relative_logit_mse"]:
+        failures.append(
+            f"relative logit MSE {logit_mse:.3e} above the pinned "
+            f"budget {Q8_QUALITY['max_relative_logit_mse']:.0e}"
+        )
+    if failures:
+        print(json.dumps(row), file=sys.stderr)
+        raise SystemExit(
+            "serving_quant invariants violated: " + "; ".join(failures)
+        )
+    return [row]
+
+
 def bench_serving_chaos(args) -> list[dict]:
     """The robustness cost of surviving faults, measured: one seeded
     mixed-length arrival stream through the batched engine twice —
@@ -1101,6 +1422,18 @@ def main() -> int:
                          "engine at equal pool HBM on a shared-prefix "
                          "arrival stream "
                          "(benchmarks/serving_paged_bench.json)")
+    ap.add_argument("--kv-quant", default="none",
+                    choices=("none", "int8"),
+                    help="with --serving-paged: bench int8 QUANTIZED KV "
+                         "pages vs the f32 paged engine on one seeded "
+                         "stream — ~0.25-0.3x page-pool HBM at f32 cache "
+                         "dtype, quality budget + zero-steady-compile "
+                         "ASSERTED (benchmarks/serving_quant_bench.json)")
+    ap.add_argument("--weight-quant", default="none",
+                    choices=("none", "int8"),
+                    help="with --kv-quant: additionally quantize the "
+                         "decode projection weights (int8 weight-only, "
+                         "per-out-channel scales) on the quantized legs")
     ap.add_argument("--chaos", action="store_true",
                     help="with --serving-batched: add the robustness leg "
                          "— the same seeded arrival stream under a "
@@ -1121,6 +1454,13 @@ def main() -> int:
 
     if args.chaos and not args.serving_batched:
         ap.error("--chaos requires --serving-batched")
+    if args.kv_quant != "none" and not args.serving_paged:
+        ap.error("--kv-quant requires --serving-paged (quantized pages "
+                 "are a block-pool feature)")
+    if args.weight_quant != "none" and args.kv_quant == "none":
+        ap.error("--weight-quant rides the quantized bench legs — pass "
+                 "--kv-quant int8 too (alone it would be silently "
+                 "ignored)")
     if args.serving or args.serving_batched or args.serving_paged:
         rows = []
         if args.serving:
@@ -1131,7 +1471,10 @@ def main() -> int:
             else:
                 rows += bench_serving_batched(args)
         if args.serving_paged:
-            rows += bench_serving_paged(args)
+            if args.kv_quant != "none":
+                rows += bench_serving_quant(args)
+            else:
+                rows += bench_serving_paged(args)
         for row in rows:
             print(json.dumps(row))
         if args.json:
