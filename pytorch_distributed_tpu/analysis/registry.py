@@ -210,6 +210,7 @@ def _build_batched_engine(
     budget: CollectiveBudget | None = NO_COLLECTIVES,
     budget_case: str | None = None,
     weight_quant: str = "none",
+    lora_rank: int | None = None,
     audit_extra: dict | None = None,
 ):
     """A slot-batched serving program (serving/engine.BatchedDecodeEngine):
@@ -232,6 +233,7 @@ def _build_batched_engine(
     engine = BatchedDecodeEngine(
         cfg, slots=4, max_len=16, buckets=BucketSpec((8, 16)),
         mesh_cfg=mesh_cfg, weight_quant=weight_quant,
+        adapters=_lora_registry(cfg, lora_rank),
     )
     fn = engine.program(kind)
     args = engine.example_args(kind, engine._place_params(params))
@@ -245,11 +247,27 @@ def _build_batched_engine(
     }
 
 
+def _lora_registry(cfg, rank: int | None):
+    """A one-tenant AdapterRegistry for the LoRA audit cases (None ->
+    no registry: the adapter-less program signatures). One registered
+    tenant is enough — the traced operand shapes carry ``max_tenants +
+    1`` slots either way, and the audit pins structure, not values."""
+    if rank is None:
+        return None
+    from pytorch_distributed_tpu.serving.adapters import AdapterRegistry
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    reg = AdapterRegistry(cfg, rank=rank, max_tenants=2)
+    reg.register("audit-tenant", key=domain_key(7, "misc"))
+    return reg
+
+
 def _build_paged_engine(
     kind: str,
     budget: CollectiveBudget | None = NO_COLLECTIVES,
     kv_quant: str = "none",
     weight_quant: str = "none",
+    lora_rank: int | None = None,
     audit_extra: dict | None = None,
 ):
     """A paged slot-batched serving program
@@ -271,6 +289,7 @@ def _build_paged_engine(
     engine = PagedBatchedDecodeEngine(
         cfg, slots=4, max_len=16, page_size=8, pool_pages=8,
         prefill_chunk=8, kv_quant=kv_quant, weight_quant=weight_quant,
+        adapters=_lora_registry(cfg, lora_rank),
     )
     fn = engine.program(kind)
     args = engine.example_args(kind, engine._place_params(params))
@@ -619,6 +638,54 @@ def registered_cases() -> dict[str, AuditCase]:
                 audit_extra={
                     "q8_cast_budget": {"to_int8": 0, "from_int8": 4},
                 },
+            ),
+        ),
+        # Multi-tenant LoRA serving programs (serving/adapters.py): the
+        # stacked per-tenant low-rank deltas ride the paged programs as
+        # two extra TRACED operands (adapter tree + [B] tenant slots).
+        # The contract under audit: adapters add einsums, never
+        # collectives (per-row gathers are slot indexing, nothing
+        # cross-row), and the donated page pool still strictly aliases
+        # — N tenants cost zero extra compiles/caches by construction.
+        AuditCase(
+            "decode_paged_prefill_lora",
+            "paged chunked prefill with per-row LoRA deltas (stacked "
+            "adapter tree + tenant-slot vector as traced operands, "
+            "donated page pool): single device, any collective is a bug",
+            1,
+            lambda: _build_paged_engine("prefill", lora_rank=4),
+        ),
+        AuditCase(
+            "decode_paged_step_lora",
+            "paged decode step with per-row LoRA deltas: strict "
+            "donation of the pool, no collectives — tenant isolation "
+            "is a gather, not a communication",
+            1,
+            lambda: _build_paged_engine("decode_step", lora_rank=4),
+        ),
+        AuditCase(
+            "decode_batched_step_tp_lora",
+            "slot-batched decode step over tensor=4 with per-row LoRA "
+            "deltas: column-parallel targets shard the B factor, row-"
+            "parallel targets join the base partial BEFORE the psum "
+            "(linearity shares the reduction), so the pinned Megatron "
+            "all-reduce count (2) must survive adapters unchanged",
+            4,
+            lambda: _build_batched_engine(
+                "decode_step",
+                mesh_cfg=MeshConfig(tensor=4, strategy="no_shard"),
+                lora_rank=4,
+                budget=CollectiveBudget(
+                    required={"all-reduce"},
+                    forbidden={
+                        "all-gather", "reduce-scatter", "all-to-all",
+                        "collective-permute",
+                    },
+                    note="adapters must not move the Megatron "
+                         "collective structure: the delta is a per-row "
+                         "linear term summed into the existing partial",
+                ),
+                budget_case="decode_batched_step_tp",
             ),
         ),
         # pjit twins of the explicit cases (parallel/api.py). Budgets per

@@ -257,6 +257,53 @@ def _write_kv(kv, k_new, v_new, pos, block_tables=None, kv_quant="none"):
     }
 
 
+def lora_delta(x, lp, rows):
+    """Per-row low-rank delta: the multi-tenant LoRA term
+    (serving/adapters.py). ``x`` [B, T, Din] is the projection's input;
+    ``lp`` is one layer's adapter slice — {"a": [slots, Din, r],
+    "b": [slots, r, *out]} with slot 0 the zero adapter; ``rows`` [B]
+    int32 picks each row's tenant slot. Returns [B, T, *out]:
+
+        delta[b] = (x[b] @ a[rows[b]]) @ b[rows[b]]
+
+    Nothing cross-row (tenant isolation is structural: row b's output
+    can only read slot rows[b]) and nothing collective (under TP the
+    caller routes the delta through the projection's EXISTING psum —
+    ops/layers.dense ``extra_pre_reduce`` / the pre-``tp_reduce`` add —
+    so the pinned Megatron all-reduce counts are untouched). A slot-0
+    row's delta is exactly 0.0, and adding exact zeros is exact: no-
+    tenant rows stay bit-equal the adapter-less engine.
+
+    Lowering: two PLAIN 2D matmuls against ALL slots (batch flattened
+    into the M dimension, the slot axis into N) with exact
+    ``take_along_axis`` slot selection — NOT gather-then-batched-einsum.
+    A B-batched GEMM's per-lane summation order varies with the batch
+    shape on XLA:CPU, and the engines dispatch the same row under
+    DIFFERENT batch shapes (prefill group sizes depend on queue churn);
+    the flattened form keeps each output element a fixed-order dot over
+    the contracting dim — the same shape family as the base
+    projections, whose cross-group bit-stability the serving pins have
+    relied on since PR 5. Cost: the rank-r GEMMs widen by the slot
+    count — noise next to the base D x D projections."""
+    a = lp["a"]  # [S, Din, r]
+    bm = lp["b"]  # [S, r, *out]
+    s_n, din, r = a.shape
+    bsz, t = x.shape[:2]
+    sel = rows[:, None, None, None]
+    xf = x.reshape(bsz * t, din).astype(a.dtype)
+    h_all = (xf @ a.transpose(1, 0, 2).reshape(din, s_n * r)).reshape(
+        bsz, t, s_n, r
+    )
+    h = jnp.take_along_axis(h_all, sel, axis=2)  # [B, T, 1, r]
+    bmat = bm.reshape(s_n, r, -1)  # [S, r, out]
+    out = bmat.shape[-1]
+    d_all = (
+        h.reshape(bsz * t, r) @ bmat.transpose(1, 0, 2).reshape(r, s_n * out)
+    ).reshape(bsz, t, s_n, out)
+    d = jnp.take_along_axis(d_all, sel, axis=2)[:, :, 0]
+    return d.reshape(x.shape[:2] + bm.shape[2:])
+
+
 def _moe_mlp(m, mlp_params, cfg, act, tensor_axis=None):
     """Routed MLP for decode: top-1/top-k routing is per-token and
     cache-free, so only the MLP call differs from training. Capacity is
@@ -281,17 +328,30 @@ def _moe_mlp(m, mlp_params, cfg, act, tensor_axis=None):
 
 
 def _gpt2_block(x, bp, kv, pos, cfg, tensor_axis=None,
-                block_tables=None, paged_impl="gather", kv_quant="none"):
+                block_tables=None, paged_impl="gather", kv_quant="none",
+                lora=None, lora_rows=None):
     eps = cfg.layer_norm_epsilon
     b, t = x.shape[:2]
     a = layer_norm(x, bp["ln_1"], eps=eps)
     qkv = dense(a, bp["attn"]["c_attn"])  # [B, T, 3, H(/tp), D]
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if lora is not None:
+        # Query-only on the fused projection: K/V stay tenant-agnostic
+        # so cached pages keep their pure-function-of-tokens soundness
+        # (serving/adapters.py).
+        q = q + lora_delta(a, lora["q"], lora_rows).astype(q.dtype)
     kv = _write_kv(kv, k, v, pos, block_tables, kv_quant)
     a = _cached_attention(
         q, kv, pos, block_tables, paged_impl, kv_quant
     ).reshape(b, t, -1)
-    x = x + dense(a, bp["attn"]["c_proj"], tp_reduce_axis=tensor_axis)
+    proj_extra = (
+        lora_delta(a, lora["c_proj"], lora_rows)
+        if lora is not None else None
+    )
+    x = x + dense(
+        a, bp["attn"]["c_proj"], tp_reduce_axis=tensor_axis,
+        extra_pre_reduce=proj_extra,
+    )
     m = layer_norm(x, bp["ln_2"], eps=eps)
     act = activation(cfg.activation_function)
     if cfg.n_experts:
@@ -302,7 +362,8 @@ def _gpt2_block(x, bp, kv, pos, cfg, tensor_axis=None,
 
 
 def _llama_block(x, bp, kv, pos, cfg, cos, sin, tensor_axis=None,
-                 block_tables=None, paged_impl="gather", kv_quant="none"):
+                 block_tables=None, paged_impl="gather", kv_quant="none",
+                 lora=None, lora_rows=None):
     from pytorch_distributed_tpu.ops.quant import qdot
     from pytorch_distributed_tpu.ops.tp import tp_reduce
 
@@ -312,14 +373,27 @@ def _llama_block(x, bp, kv, pos, cfg, cos, sin, tensor_axis=None,
     a = rms_norm(x, bp["ln_attn"], eps=eps)
     # qdot == `a @ w.astype(a.dtype)` for plain weights (bit-identical
     # dot_general) and the int8 weight-only matmul for quantized ones.
-    q = apply_rope(qdot(a, bp["attn"]["wq"]).reshape(b, t, -1, d), cos, sin)
+    q_pre = qdot(a, bp["attn"]["wq"])
+    if lora is not None:
+        # wq (column-parallel) + wo (row-parallel, delta joins the
+        # partial BEFORE the psum); wk/wv deliberately untouched so
+        # cached K/V stays tenant-agnostic (serving/adapters.py).
+        q_pre = q_pre + lora_delta(a, lora["wq"], lora_rows).astype(
+            q_pre.dtype
+        )
+    q = apply_rope(q_pre.reshape(b, t, -1, d), cos, sin)
     k = apply_rope(qdot(a, bp["attn"]["wk"]).reshape(b, t, -1, d), cos, sin)
     v = qdot(a, bp["attn"]["wv"]).reshape(b, t, -1, d)
     kv = _write_kv(kv, k, v, pos, block_tables, kv_quant)
     a = _cached_attention(
         q, kv, pos, block_tables, paged_impl, kv_quant
     ).reshape(b, t, -1)
-    x = x + tp_reduce(qdot(a, bp["attn"]["wo"]), tensor_axis)
+    wo_out = qdot(a, bp["attn"]["wo"])
+    if lora is not None:
+        wo_out = wo_out + lora_delta(a, lora["wo"], lora_rows).astype(
+            wo_out.dtype
+        )
+    x = x + tp_reduce(wo_out, tensor_axis)
     m = rms_norm(x, bp["ln_mlp"], eps=eps)
     if cfg.n_experts:
         return x + _moe_mlp(m, bp["mlp"], cfg, jax.nn.silu, tensor_axis), kv
@@ -342,6 +416,7 @@ def forward(
     block_tables: jax.Array | None = None,
     paged_impl: str = "gather",
     kv_quant: str = "none",
+    lora: tuple | None = None,
 ) -> tuple[jax.Array, Cache]:
     """Run T tokens at positions pos..pos+T-1. Returns ([B, T, V] logits,
     updated cache). MoE configs route each token through the expert MLPs
@@ -375,6 +450,15 @@ def forward(
     window's gathers are issued before its first block computes, so layer
     l+1's shards stream in under layer l's compute (serving/engine.py).
     Bit-equivalent to the default per-layer schedule for any window size.
+
+    ``lora``: ``(stacked adapter tree, [B] tenant-slot rows)`` — the
+    multi-tenant low-rank deltas (serving/adapters.py). The tree's
+    leaves are [L, slots, ...] and scan alongside the blocks; each
+    row's delta is applied per-row inside the blocks
+    (``lora_delta``) with slot 0 the exact-zero adapter. Incompatible
+    with ``block_transform`` (the ZeRO-3 gather hook transforms the
+    whole sliced tree — adapters are plain operands, not sharded
+    params), rejected loudly.
     """
     b, t = input_ids.shape
     dtype = jnp.dtype(cfg.dtype)
@@ -395,6 +479,18 @@ def forward(
             "dense caches stay full precision — quantized pages are the "
             "block-pool feature (init_paged_cache(kv_quant=...))"
         )
+    lora_tree = lora_rows = None
+    if lora is not None:
+        if block_transform is not None:
+            raise ValueError(
+                "lora adapters are incompatible with block_transform "
+                "(ZeRO-3 decode): the gather hook transforms the whole "
+                "sliced layer tree, and the stacked adapter operands are "
+                "plain per-dispatch values, not sharded params — serve "
+                "adapters from plain or tensor-only meshes"
+            )
+        lora_tree, lora_rows = lora
+        lora_rows = jnp.asarray(lora_rows, jnp.int32)
 
     if cfg.family == "gpt2":
         if per_row:
@@ -423,18 +519,29 @@ def forward(
     else:
         raise KeyError(f"unknown model family {cfg.family!r}")
 
-    def block_body(x, bp, kv_l):
-        # ``kv_l`` is one layer's cache-leaf dict (k/v, plus the scale
-        # pools when quantized) — scan_layers slices/stacks the whole
-        # dict, so the leaf set is the cache layout's business, not the
-        # scan's.
+    def block_body(x, bp, extra):
+        # ``extra["kv"]`` is one layer's cache-leaf dict (k/v, plus the
+        # scale pools when quantized) — scan_layers slices/stacks the
+        # whole dict, so the leaf set is the cache layout's business,
+        # not the scan's. ``extra["lora"]`` (when adapters ride the
+        # dispatch) is that layer's [slots, ...] adapter slice; the
+        # [B] rows vector is layer-invariant and closes over the scan.
+        kv_l = extra["kv"]
+        if lora_tree is not None:
+            return block(
+                x, bp, kv_l, pos,
+                lora=extra["lora"], lora_rows=lora_rows,
+            )
         return block(x, bp, kv_l, pos)
 
+    extras = {"kv": cache}
+    if lora_tree is not None:
+        extras["lora"] = lora_tree
     x, kv = scan_layers(
         block_body,
         x,
         params["blocks"],
-        extras=cache,
+        extras=extras,
         remat_mode="none",
         block_transform=block_transform,
         prefetch_buffers=prefetch_buffers,

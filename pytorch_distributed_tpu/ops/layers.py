@@ -21,7 +21,8 @@ from pytorch_distributed_tpu.ops.tp import tp_reduce
 
 
 def dense(
-    x: jax.Array, params: dict, *, precision=None, tp_reduce_axis=None
+    x: jax.Array, params: dict, *, precision=None, tp_reduce_axis=None,
+    extra_pre_reduce: jax.Array | None = None,
 ) -> jax.Array:
     """y = x @ kernel + bias. kernel: [in, out]; bias optional.
 
@@ -29,6 +30,13 @@ def dense(
     row-parallel over — the kernel's input dim is sharded, each shard
     computes a partial sum, and the psum (ops/tp.tp_reduce) runs BEFORE the
     (replicated) bias is added so the bias is counted once.
+
+    ``extra_pre_reduce``: an addend joined to the (possibly partial)
+    matmul output BEFORE the tp psum — the per-row LoRA delta path
+    (models/decode.lora_delta): on a row-parallel projection the delta
+    is itself a per-shard partial, and linearity means summing
+    (base + delta) partials in ONE psum equals psumming each — the
+    pinned TP collective counts are untouched by adapters.
 
     A quantized kernel (ops/quant.quantize_weight dict: int8 values +
     per-out-channel f32 scale) runs through the same ``ops.quant.qdot``
@@ -41,6 +49,8 @@ def dense(
     from pytorch_distributed_tpu.ops.quant import qdot
 
     y = qdot(x, params["kernel"], precision=precision)
+    if extra_pre_reduce is not None:
+        y = y + extra_pre_reduce.astype(y.dtype)
     if tp_reduce_axis is not None:
         y = tp_reduce(y, tp_reduce_axis)
     bias = params.get("bias")

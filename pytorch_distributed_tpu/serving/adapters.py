@@ -1,0 +1,255 @@
+"""Multi-tenant LoRA adapters for the batched serving engines.
+
+N tenants fine-tune one base model with low-rank deltas; serving them
+as N engines would cost N param trees, N KV pools, and N compiled
+program sets. Instead the adapters ride the EXISTING programs as
+TRACED per-row terms: every dispatch takes (a) one stacked adapter
+tree — ``[L, slots, ...]`` low-rank factors with tenant slot 0
+permanently the ZERO adapter — and (b) a ``[B]`` int32 tenant-slot
+vector, and ``models/decode.forward`` applies each row's delta as a
+per-row ``(B, r)·(r, D)`` pair of einsums next to the base projection
+(``decode.lora_delta``). Consequences, all machine-checked:
+
+- **Zero extra compiles**: the stacked tree is preallocated at
+  ``max_tenants + 1`` slots, so registering a tenant changes operand
+  VALUES, never shapes — N tenants share the warmed compile set
+  (registry cases ``decode_paged_*_lora`` pin it, and the churn test
+  asserts ``compile_count`` flat across registrations).
+- **Zero extra caches, and the prefix cache stays tenant-agnostic**:
+  the target set deliberately never touches a K or V projection
+  (query + attention-output only), so a cache position's K/V remains a
+  pure function of the TOKENS alone — two tenants sharing a system
+  prompt share its pages, and prefix-cache keys need no tenant salt.
+  An adapter on wk/wv would silently poison cross-tenant sharing;
+  extending the target set there means folding the tenant slot into
+  the block-pool chain keys first.
+- **Per-tenant isolation**: row b's delta reads only
+  ``stack[tenants[b]]`` — a gather, no cross-row term — so the PR-5
+  neighbour-independence pin extends per tenant: a tenant's rows in a
+  mixed batch are bit-equal the same requests on an engine serving that
+  tenant alone, and slot-0 rows are bit-equal the adapter-less base
+  engine (adding an exact-zero delta is exact).
+- **TP composes**: column-parallel targets (q) shard the B factor's
+  output axis with the base weight; row-parallel targets (``c_proj`` /
+  ``wo``) shard the A factor's contracting dim instead, and the delta
+  joins the base PARTIAL before the existing Megatron psum — linearity
+  makes the reduction shared, so the pinned all-reduce=2 survives
+  (``decode_batched_step_tp_lora`` in the audit registry).
+
+Targets (classic LoRA attention placement, K/V excluded by design):
+- gpt2: ``q`` (the query third of the fused c_attn output) and
+  ``c_proj`` (attention output).
+- llama: ``wq`` and ``wo``.
+
+Registration is host-side and rare; ``device_tree()`` memoizes the
+device upload per registry ``version``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pytorch_distributed_tpu.config import ModelConfig
+from pytorch_distributed_tpu.utils.logging import log_event
+
+
+def _targets(cfg: ModelConfig) -> dict[str, tuple[tuple, tuple, int | None]]:
+    """target name -> (A shape, B shape, TP axis) where the shapes are
+    per-tenant WITH the leading layer dim ([L, in..] / [L, out..]; the
+    rank dim is appended/inserted by the registry) and the TP axis is
+    the B-factor axis (indexed on the B shape) that shards under tensor
+    parallelism — None marks a ROW-parallel target whose A factor
+    contracts the sharded input dim instead."""
+    l, e = cfg.n_layer, cfg.n_embd
+    h, d = cfg.n_head, cfg.head_dim
+    if cfg.family == "gpt2":
+        return {
+            "q": ((l, e), (l, h, d), 1),  # query third of fused c_attn
+            "c_proj": ((l, e), (l, e), None),  # attention out (row-par)
+        }
+    if cfg.family == "llama":
+        return {
+            "wq": ((l, e), (l, h * d), 1),
+            "wo": ((l, h * d), (l, e), None),
+        }
+    raise KeyError(f"unknown model family {cfg.family!r}")
+
+
+class AdapterRegistry:
+    """Per-tenant low-rank adapter store for ONE model config. Build
+    once, share across every replica engine (the router's
+    ``make_engine`` closure): tenant slots are then consistent across
+    failover adoption. All tenants share one ``rank`` — the traced
+    operand shape bakes it in, and per-tenant ranks would be per-tenant
+    compiles, exactly what this subsystem exists to avoid."""
+
+    def __init__(
+        self, cfg: ModelConfig, *, rank: int, max_tenants: int = 8
+    ) -> None:
+        if rank < 1:
+            raise ValueError(
+                f"LoRA rank must be >= 1, got {rank}: a rank-0 adapter "
+                "is the zero map — register no adapter (tenant slot 0 "
+                "is already the shared zero adapter) instead of paying "
+                "two einsums per projection for nothing"
+            )
+        if max_tenants < 1:
+            raise ValueError(
+                f"max_tenants must be >= 1, got {max_tenants}"
+            )
+        if cfg.n_experts:
+            raise NotImplementedError(
+                "LoRA adapters do not cover MoE configs (routed expert "
+                "weights have no single projection to adapt) — serve "
+                "dense gpt2/llama configs"
+            )
+        self.cfg = cfg
+        self.rank = int(rank)
+        self.max_tenants = int(max_tenants)
+        self._targets = _targets(cfg)
+        slots = self.max_tenants + 1  # slot 0 = the zero adapter
+        self._host: dict[str, dict[str, np.ndarray]] = {}
+        for name, (a_shape, b_shape, _) in self._targets.items():
+            l = a_shape[0]
+            self._host[name] = {
+                # Stacked [L, slots, ...] — layer-major so scan_layers
+                # slices the layer dim exactly like the base blocks.
+                "a": np.zeros(
+                    (l, slots) + a_shape[1:] + (self.rank,), np.float32
+                ),
+                "b": np.zeros(
+                    (l, slots, self.rank) + b_shape[1:], np.float32
+                ),
+            }
+        self._slots: dict[str, int] = {}
+        self.version = 0
+        self._device: tuple[int, dict] | None = None
+
+    def tenants(self) -> tuple[str, ...]:
+        return tuple(self._slots)
+
+    def slot(self, tenant_id) -> int:
+        """Tenant id -> adapter slot; unknown tenants are rejected
+        loudly at every submit entry point (engine, router, HTTP 400)."""
+        s = self._slots.get(tenant_id)
+        if s is None:
+            raise ValueError(
+                f"unregistered tenant {tenant_id!r}: known tenants are "
+                f"{sorted(map(repr, self._slots))} — register adapters "
+                "with AdapterRegistry.register before submitting"
+            )
+        return s
+
+    def register(
+        self, tenant_id, adapters: dict | None = None, *,
+        key=None, scale: float = 1.0,
+    ) -> int:
+        """Install one tenant's adapters into the next free slot and
+        return it. Either pass ``adapters`` — {target: {"a": [L, ..in,
+        r], "b": [L, r, ..out]}} matching this config's target shapes —
+        or a PRNG ``key`` for a random NONZERO init (tests/benches; a
+        real deployment loads trained factors). ``scale`` is the usual
+        LoRA alpha/r factor, folded into B host-side so the trace pays
+        nothing for it. Values change, shapes never: registration can
+        never recompile a warmed engine."""
+        import jax
+
+        if tenant_id in self._slots:
+            raise ValueError(
+                f"tenant {tenant_id!r} is already registered (slot "
+                f"{self._slots[tenant_id]}); build a new registry to "
+                "replace adapters — engines memoize the device tree by "
+                "version, so silent in-place swaps would be a footgun"
+            )
+        if len(self._slots) >= self.max_tenants:
+            raise ValueError(
+                f"adapter registry is full ({self.max_tenants} "
+                "tenants): raise max_tenants at construction (the "
+                "stacked operand is preallocated, so capacity is a "
+                "build-time choice)"
+            )
+        if adapters is None and key is None:
+            raise ValueError(
+                "register needs either explicit adapters= factors or a "
+                "key= for random init"
+            )
+        slot = len(self._slots) + 1
+        for i, (name, (a_shape, b_shape, _)) in enumerate(
+            self._targets.items()
+        ):
+            a_full = a_shape + (self.rank,)
+            b_full = (b_shape[0], self.rank) + b_shape[1:]
+            if adapters is not None:
+                got = adapters.get(name)
+                if got is None:
+                    raise ValueError(
+                        f"adapters missing target {name!r} (this config "
+                        f"adapts {sorted(self._targets)})"
+                    )
+                a = np.asarray(got["a"], np.float32)
+                b = np.asarray(got["b"], np.float32)
+                if a.shape != a_full or b.shape != b_full:
+                    raise ValueError(
+                        f"tenant {tenant_id!r} target {name!r}: factor "
+                        f"shapes {a.shape}/{b.shape} do not match the "
+                        f"config's {a_full}/{b_full} (rank={self.rank})"
+                    )
+            else:
+                ka, kb = jax.random.split(jax.random.fold_in(key, i))
+                a = 0.02 * np.asarray(
+                    jax.random.normal(ka, a_full), np.float32
+                )
+                b = 0.02 * np.asarray(
+                    jax.random.normal(kb, b_full), np.float32
+                )
+            self._host[name]["a"][:, slot] = a
+            self._host[name]["b"][:, slot] = b * (scale / self.rank)
+        self._slots[tenant_id] = slot
+        self.version += 1
+        log_event(
+            "tenant_register", tenant=str(tenant_id), slot=slot,
+            rank=self.rank,
+        )
+        return slot
+
+    def device_tree(self) -> dict:
+        """The stacked adapter operand tree as device arrays, memoized
+        per registry version (one upload per registration, not per
+        dispatch)."""
+        import jax.numpy as jnp
+
+        if self._device is None or self._device[0] != self.version:
+            self._device = (
+                self.version,
+                {
+                    name: {
+                        "a": jnp.asarray(leaves["a"]),
+                        "b": jnp.asarray(leaves["b"]),
+                    }
+                    for name, leaves in self._host.items()
+                },
+            )
+        return self._device[1]
+
+    def partition_specs(self, tensor_axis: str = "tensor") -> dict:
+        """PartitionSpec tree matching ``device_tree`` for the TP
+        shard_map in_specs: column-parallel B factors shard their output
+        axis with the base weight, row-parallel targets (``c_proj`` /
+        ``wo``) shard the A factor's contracting dim instead — the
+        delta partial then joins the base partial BEFORE the existing
+        tp_reduce psum (``decode.lora_delta`` is collective-free), so
+        the pinned all-reduce count is unchanged."""
+        from jax.sharding import PartitionSpec as P
+
+        specs: dict = {}
+        for name, (a_shape, b_shape, b_axis) in self._targets.items():
+            # Stacked layouts: a = [L, slots, in.., r], b = [L, slots,
+            # r, out..]; axis indices below count on those.
+            a_spec = [None] * (len(a_shape) + 2)
+            b_spec = [None] * (len(b_shape) + 2)
+            if b_axis is not None:  # column-parallel: B out dim shards
+                b_spec[2 + b_axis] = tensor_axis
+            else:  # row-parallel: A contracts the sharded input dim
+                a_spec[2] = tensor_axis
+            specs[name] = {"a": P(*a_spec), "b": P(*b_spec)}
+        return specs

@@ -92,6 +92,15 @@ class BlockPool:
         # Insertion-ordered = LRU order; match_prefix refreshes recency.
         self._cache: dict[str, _CachedChunk] = {}
         self._cached_pages: set[int] = set()
+        # Chunk keys PINNED against LRU eviction (session-aware
+        # retention, serving/session.py): a live chat session's prefix
+        # chunks stay resident between turns even under allocation
+        # pressure — the pin, not recency, is what keeps turn N+1's
+        # prefill ~one chunk. Bounded by the engine's pin budget.
+        # REFCOUNTED per key: two sessions sharing a system-prompt
+        # prefix pin the same chunks, and one closing must not strip
+        # the survivor's retention.
+        self._pinned: dict[str, int] = {}
         self.stats: dict[str, int] = {
             "prefix_queries": 0,
             "prefix_hits": 0,
@@ -118,6 +127,60 @@ class BlockPool:
         """Pages holding content (referenced OR retained by the prefix
         cache) — everything not on the free list."""
         return self.pool_pages - 1 - len(self._free)
+
+    def allocatable_pages(self) -> int:
+        """Pages the allocator can actually deliver: immediately free
+        plus whole cached-and-unpinned chunks no live row references —
+        exactly what LRU eviction reclaims on demand (``_evictable``'s
+        rule). The BATCH admission gate reads THIS, not ``free_pages``:
+        a pool idling full of retired prefixes is headroom, not
+        pressure — only live working sets and session pins subtract."""
+        evictable = sum(
+            len(chunk.pids)
+            for key, chunk in self._cache.items()
+            if key not in self._pinned
+            and all(self._ref.get(p, 0) == 0 for p in chunk.pids)
+        )
+        return len(self._free) + evictable
+
+    def pinned_pages(self) -> int:
+        """Pages held ONLY by a pin: in pinned chunks and not currently
+        referenced by any live row. This is the capacity a pin takes
+        away from the allocator beyond the working set (``pages_in_use``
+        already counts referenced pages), so it is the figure
+        ``engine.stats()`` reports and the router's least-loaded scoring
+        adds to page pressure — a session-heavy replica looks loaded
+        BEFORE it starts preempting for its pinned residents."""
+        return sum(
+            1
+            for key in self._pinned
+            if key in self._cache
+            for pid in self._cache[key].pids
+            if self._ref.get(pid, 0) == 0
+        )
+
+    def pin(self, keys) -> None:
+        """Protect cached chunks from LRU eviction (unknown keys are
+        ignored — a chunk can lose the first-writer race or die with a
+        pool reset before its pin lands). Pins are REFCOUNTED: each
+        holder unpins exactly what it pinned, and the chunk returns to
+        LRU only when the last holder lets go."""
+        for key in keys:
+            if key in self._cache:
+                self._pinned[key] = self._pinned.get(key, 0) + 1
+
+    def unpin(self, keys) -> None:
+        """Release one holder's pins (idempotent for keys whose pin
+        never landed); a chunk returns to ordinary LRU retention when
+        its last holder unpins."""
+        for key in keys:
+            n = self._pinned.get(key)
+            if n is None:
+                continue
+            if n <= 1:
+                del self._pinned[key]
+            else:
+                self._pinned[key] = n - 1
 
     def _bump_peak(self) -> None:
         n = self.pages_in_use()
@@ -152,6 +215,8 @@ class BlockPool:
 
     def _evictable(self) -> str | None:
         for key, chunk in self._cache.items():  # LRU-first
+            if key in self._pinned:
+                continue  # session-pinned: survives pressure
             if all(self._ref.get(p, 0) == 0 for p in chunk.pids):
                 return key
         return None
@@ -193,6 +258,18 @@ class BlockPool:
         h.update(prev.encode())
         h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
         return h.hexdigest()
+
+    def chain_keys(self, tokens: np.ndarray, length: int) -> list[str]:
+        """The chain keys of every full chunk covering
+        ``tokens[:length]`` (length floored to a chunk multiple) — what
+        session retention pins. Pure digests: no cache reads, no
+        references taken."""
+        c = self.chunk_tokens
+        key, keys = "", []
+        for start in range(0, (int(length) // c) * c, c):
+            key = self._chain_digest(key, tokens[start:start + c])
+            keys.append(key)
+        return keys
 
     def match_prefix(
         self, tokens: np.ndarray, max_tokens: int
@@ -280,3 +357,4 @@ class BlockPool:
         self._ref.clear()
         self._cache.clear()
         self._cached_pages.clear()
+        self._pinned.clear()

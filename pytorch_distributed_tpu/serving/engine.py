@@ -97,6 +97,17 @@ from pytorch_distributed_tpu.serving.lifecycle import (
     RequestFailed,
     RequestResult,
 )
+from pytorch_distributed_tpu.serving.scheduler import (
+    BATCH,
+    INTERACTIVE,
+    PRIORITIES,
+    STANDARD,
+    TIER_NAME,
+    TIER_RANK,
+    check_priority,
+    preemption_key,
+    queue_key,
+)
 from pytorch_distributed_tpu.utils.logging import log_event
 
 _PROGRAM_KINDS = ("prefill", "decode_run", "decode_step")
@@ -342,12 +353,15 @@ class DecodeEngine:
         return {
             "engine": type(self).__name__,
             "queue_depth": 0,
+            "queue_depth_by_tier": {name: 0 for name in PRIORITIES},
             "slots": None,
             "active_rows": 0,
             "free_slots": None,
             "pool_pages": None,
             "free_pages": None,
             "pages_in_use": None,
+            "session_pinned_pages": None,
+            "sessions": None,
             "prefix_hit_rate": None,
             "kv_quant": "none",
             "counters": dict(self.counters),
@@ -847,6 +861,14 @@ class _Pending:
     gen: list = dataclasses.field(default_factory=list)  # resume prefix
     retries: int = 0  # fault-resume count (dispatch failures)
     nan_retried: bool = False  # quarantine: one retry, then FAILED
+    # Workload-scenario fields (serving/scheduler.py / session.py /
+    # adapters.py): the SLO tier rank, the session a turn belongs to,
+    # how many tokens of its prompt are a resubmitted transcript (the
+    # session hit-rate denominator), and the row's tenant adapter slot.
+    tier: int = TIER_RANK[STANDARD]
+    session: int | None = None
+    resub_len: int = 0
+    tenant_slot: int = 0
 
 
 @dataclasses.dataclass
@@ -868,6 +890,10 @@ class _Slot:
     deadline: float | None = None
     retries: int = 0
     nan_retried: bool = False
+    tier: int = TIER_RANK[STANDARD]
+    session: int | None = None
+    resub_len: int = 0
+    tenant_slot: int = 0
 
 
 class BatchedDecodeEngine:
@@ -966,6 +992,7 @@ class BatchedDecodeEngine:
         clock=None,
         sleep=None,
         weight_quant: str = "none",
+        adapters=None,
     ) -> None:
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -1009,6 +1036,20 @@ class BatchedDecodeEngine:
             cfg, mesh_cfg, entry="BatchedDecodeEngine", allow_zero3=False
         )
         self.weight_quant = _check_quant_arg("weight_quant", weight_quant)
+        # Multi-tenant LoRA (serving/adapters.py): when a registry is
+        # attached, every dispatch carries TWO extra traced operands —
+        # the stacked adapter tree and a [B] tenant-slot vector — so the
+        # program SIGNATURES differ from the adapter-less engine (built
+        # once, at construction; registration later changes values,
+        # never shapes, hence never programs). No registry = the exact
+        # pre-LoRA programs, so the existing audit pins are untouched.
+        if adapters is not None and adapters.cfg != cfg:
+            raise ValueError(
+                "adapters= was built for a different ModelConfig than "
+                "this engine serves — one registry per architecture "
+                "(build it once and share it across replicas)"
+            )
+        self.adapters = adapters
         if self.mode == "tp":
             (
                 self._mesh, self._p_specs, self._param_shardings
@@ -1103,10 +1144,12 @@ class BatchedDecodeEngine:
 
     # -- programs ----------------------------------------------------------
 
-    def _forward(self, params, ids, cache, pos):
+    def _forward(self, params, ids, cache, pos, lora=None):
         kwargs = {}
         if self.mode == "tp":
             kwargs["tensor_axis"] = "tensor"
+        if lora:
+            kwargs["lora"] = lora
         return decode.forward(params, ids, self.cfg, cache, pos, **kwargs)
 
     def _bodies(self):
@@ -1117,16 +1160,22 @@ class BatchedDecodeEngine:
         (``decode.nonfinite_rows`` over the sampled position) — the
         scheduler quarantines flagged rows; elementwise + one reduction,
         so the pinned collective budgets (registry:
-        decode_batched_step_tp all-reduce=2) are untouched by it."""
+        decode_batched_step_tp all-reduce=2) are untouched by it.
+
+        With an adapter registry attached, both bodies take two trailing
+        operands — the stacked LoRA tree and the [B] tenant-slot vector
+        (``*lora``) — applied inside ``decode.forward`` as per-row
+        deltas; without one the signatures are byte-identical to the
+        pre-LoRA engine."""
 
         def prefill(params, prompts, plens, rows, cache,
-                    greedy, t, k, p, keydata):
+                    greedy, t, k, p, keydata, *lora):
             # Gather the target rows' (dirty) segments, run the normal
             # prefill forward over them at pos 0, scatter back. Padded
             # group entries duplicate row index AND data, so the
             # overlapping scatter writes are identical (deterministic).
             seg = {kk: vv[:, rows] for kk, vv in cache.items()}
-            logits, seg = self._forward(params, prompts, seg, 0)
+            logits, seg = self._forward(params, prompts, seg, 0, lora)
             last = jnp.take_along_axis(
                 logits, (plens - 1)[:, None, None], axis=1
             )[:, 0]
@@ -1138,8 +1187,10 @@ class BatchedDecodeEngine:
             return tok, decode.nonfinite_rows(last), cache
 
         def decode_step(params, toks, cache, pos, folds,
-                        greedy, t, k, p, keydata):
-            logits, cache = self._forward(params, toks[:, None], cache, pos)
+                        greedy, t, k, p, keydata, *lora):
+            logits, cache = self._forward(
+                params, toks[:, None], cache, pos, lora
+            )
             last = logits[:, -1]
             keys = jax.vmap(jax.random.fold_in)(
                 jax.random.wrap_key_data(keydata), folds
@@ -1148,6 +1199,31 @@ class BatchedDecodeEngine:
             return tok, decode.nonfinite_rows(last), cache
 
         return {"prefill": prefill, "decode_step": decode_step}
+
+    def _lora_dispatch_args(self, tenant_slots) -> tuple:
+        """The two trailing LoRA operands for one dispatch — the
+        (version-memoized) stacked adapter tree and the per-row tenant
+        slots — or () when no registry is attached (the signatures then
+        stay the pre-LoRA ones). Free/garbage rows ride slot 0, the
+        exact-zero adapter."""
+        if self.adapters is None:
+            return ()
+        return (
+            self.adapters.device_tree(),
+            jnp.asarray(tenant_slots, jnp.int32),
+        )
+
+    def _lora_in_specs(self) -> tuple:
+        """shard_map in_specs for the two LoRA operands under TP (empty
+        without a registry): the factor tree shards per
+        ``AdapterRegistry.partition_specs`` — column-parallel B factors
+        with their base weight's output axis, row-parallel A factors on
+        the contracting dim — and the tenant-slot vector replicates."""
+        if self.adapters is None:
+            return ()
+        from jax.sharding import PartitionSpec as P
+
+        return (self.adapters.partition_specs(), P())
 
     def program(self, kind: str):
         """The jitted program for ``kind`` — public for the audit
@@ -1180,7 +1256,7 @@ class BatchedDecodeEngine:
                     self._p_specs, P(), cache_spec, P(), P(),
                     P(), P(), P(), P(), P(),
                 ),
-            }[kind]
+            }[kind] + self._lora_in_specs()
             smapped = shard_map(
                 body,
                 mesh=self._mesh,
@@ -1221,6 +1297,9 @@ class BatchedDecodeEngine:
         timeout_s: float | None = None,
         params=None,
         block_timeout_s: float | None = None,
+        priority: str = STANDARD,
+        session: int | None = None,
+        tenant=None,
     ) -> int:
         """Queue one single-sequence request ([Tp] or [1, Tp] int ids);
         returns its request id. The request is admitted into a free slot
@@ -1235,7 +1314,21 @@ class BatchedDecodeEngine:
         beyond the slot count wait their FIFO turn); with one, the
         ``reject`` policy raises ``AdmissionQueueFull`` loudly, and the
         ``block`` policy drives the scheduler (``params`` required) until
-        space frees or ``block_timeout_s`` passes, then raises."""
+        space frees or ``block_timeout_s`` passes, then raises.
+
+        Workload scenarios (all host-side — traced programs never see
+        them): ``priority`` is the SLO tier (serving/scheduler.py —
+        'interactive' admits ahead of the queue, deadline-first within
+        the tier; 'standard' is exactly the pre-tier FIFO). On the
+        DENSE engine tiers only reorder admission; the paged engine
+        additionally lets interactive preempt lower tiers, gates
+        'batch' admission on pool headroom, and preempts batch first.
+        ``session`` is a live session id from the
+        paged engine's ``open_session`` — the prompt must resubmit the
+        conversation-so-far and pays ~one chunk of prefill via the
+        pinned prefix cache. ``tenant`` picks a registered LoRA adapter
+        (engine built with ``adapters=``); None rides the shared zero
+        adapter bit-equal to the adapter-less engine."""
         prompt = np.asarray(prompt)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -1250,6 +1343,20 @@ class BatchedDecodeEngine:
         )
         if timeout_s is not None and timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+        tier = check_priority(priority)
+        tenant_slot = 0
+        if tenant is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    f"tenant={tenant!r} needs an engine built with "
+                    "adapters=AdapterRegistry(...) — this engine has no "
+                    "adapter registry attached"
+                )
+            tenant_slot = self.adapters.slot(tenant)
+        prompt = prompt.astype(np.int32)
+        # Validates BEFORE the rid is assigned (a rejected turn must not
+        # burn an id) but marks the turn in flight only after.
+        resub_len = self._session_checkin(session, prompt)
         self._admission_backpressure(params, block_timeout_s)
         rid = self._next_rid
         self._next_rid += 1
@@ -1266,18 +1373,40 @@ class BatchedDecodeEngine:
             None if timeout_s is None else self._clock() + timeout_s
         )
         self._queue.append(_Pending(
-            rid=rid, prompt=prompt.astype(np.int32), bucket=bucket,
+            rid=rid, prompt=prompt, bucket=bucket,
             max_new=int(max_new_tokens), eos_id=eos_id,
             greedy=not temperature > 0.0,
             t=float(t), k=int(k), p=float(p), keydata=keydata,
             prefill_keydata=keydata, deadline=deadline,
+            tier=tier, session=session, resub_len=resub_len,
+            tenant_slot=tenant_slot,
         ))
+        self._session_begin(session, rid)
         log_event(
             "submit", rid=rid, t=round(self._clock(), 6), prompt_len=tp,
             max_new=int(max_new_tokens),
             deadline=None if deadline is None else round(deadline, 6),
+            priority=priority if tier != TIER_RANK[STANDARD] else None,
+            session=session,
+            tenant=str(tenant) if tenant is not None else None,
         )
         return rid
+
+    def _session_checkin(self, session, prompt) -> int:
+        """Hook: validate a session turn and return its resubmitted-
+        transcript length. Sessions ride the paged engine's prefix cache
+        — the dense engines reject them loudly."""
+        if session is not None:
+            raise ValueError(
+                "multi-turn sessions need the chunk-chained prefix "
+                "cache and page pinning — open them on a "
+                "PagedBatchedDecodeEngine (serving/session.py), not "
+                f"{type(self).__name__}"
+            )
+        return 0
+
+    def _session_begin(self, session, rid) -> None:
+        """Hook: mark a validated session turn in flight (paged only)."""
 
     def _admission_backpressure(self, params, block_timeout_s) -> None:
         if self.queue_limit is None or len(self._queue) < self.queue_limit:
@@ -1524,9 +1653,15 @@ class BatchedDecodeEngine:
                 if q.gen
                 else self.buckets.bucket_for(len(q.prompt))
             )
-            self._queue.append(
-                dataclasses.replace(q, bucket=bucket, gen=list(q.gen))
-            )
+            # Session linkage is ENGINE-LOCAL and the restored engine's
+            # tracker is fresh (sid 0 will be handed out again): keeping
+            # the old sid would let a new session collide with it and
+            # corrupt its transcript. The turn completes as a plain
+            # request; its client re-opens (transcript-carrying
+            # resubmission makes that lossless).
+            self._queue.append(dataclasses.replace(
+                q, bucket=bucket, gen=list(q.gen), session=None,
+            ))
         log_event(
             "restore", t=round(self._clock(), 6),
             pending=len(snap.pending), next_rid=snap.next_rid,
@@ -1567,8 +1702,12 @@ class BatchedDecodeEngine:
                 if q.gen
                 else self.buckets.bucket_for(len(q.prompt))
             )
+            # Donor session ids mean nothing here (and could collide
+            # with a LIVE local session, corrupting its transcript):
+            # adopted turns finish as plain requests; the router's
+            # stickiness layer re-opens the session on the survivor.
             self._queue.append(dataclasses.replace(
-                q, rid=rid, bucket=bucket, gen=list(q.gen)
+                q, rid=rid, bucket=bucket, gen=list(q.gen), session=None,
             ))
             mapping[q.rid] = rid
         return mapping
@@ -1626,6 +1765,8 @@ class BatchedDecodeEngine:
             deadline=s.deadline, gen=list(s.generated),
             retries=s.retries + (1 if bump else 0),
             nan_retried=s.nan_retried if nan_retried is None else nan_retried,
+            tier=s.tier, session=s.session, resub_len=s.resub_len,
+            tenant_slot=s.tenant_slot,
         )
 
     def _partial_tokens(self, prompt, gen) -> np.ndarray:
@@ -1692,14 +1833,25 @@ class BatchedDecodeEngine:
                     f"deadline passed at t={now:.3f} mid-decode", finished,
                 )
 
+    def _queue_key(self, q: _Pending):
+        """Admission order: tier rank, then (INTERACTIVE only) earliest
+        deadline, then rid — scheduler.queue_key. An all-STANDARD queue
+        sorts exactly by rid, i.e. the pre-tier FIFO (regression-pinned
+        in tests/test_serving_scenarios.py)."""
+        return queue_key(q.tier, q.deadline, q.rid)
+
     def _admit(self, params, finished: list[int]) -> None:
         free = [i for i, s in enumerate(self._slots) if s is None]
         n = min(len(free), len(self._queue))
         if not n:
             return
-        admitted = [self._queue.popleft() for _ in range(n)]
-        # FIFO admission; arrivals sharing a bucket prefill as one
-        # batched dispatch (group padded to the next allowed size).
+        admitted = sorted(self._queue, key=self._queue_key)[:n]
+        for q in admitted:
+            self._queue.remove(q)
+        # Priority-then-FIFO admission (interactive bypasses the queue
+        # head; an all-standard stream keeps the exact pre-tier order);
+        # arrivals sharing a bucket prefill as one batched dispatch
+        # (group padded to the next allowed size).
         by_bucket: dict[int, list[tuple[_Pending, int]]] = {}
         for req in admitted:
             by_bucket.setdefault(req.bucket, []).append(
@@ -1735,6 +1887,7 @@ class BatchedDecodeEngine:
         k = np.full((npad,), self.cfg.vocab_size, np.int32)
         p = np.full((npad,), 2.0, np.float32)
         keydata = np.zeros((npad, self._key_words), np.uint32)
+        tenants = np.zeros((npad,), np.int32)
         for j, i in enumerate(idx):
             req, row = group[i]
             prefix = self._partial_tokens(req.prompt, req.gen)
@@ -1744,11 +1897,13 @@ class BatchedDecodeEngine:
             greedy[j] = req.greedy
             t[j], k[j], p[j] = req.t, req.k, req.p
             keydata[j] = req.prefill_keydata
+            tenants[j] = req.tenant_slot
         res = self._dispatch(
             "prefill", params, [req for req, _ in group], finished,
             jnp.asarray(prompts), jnp.asarray(plens),
             jnp.asarray(rows), None, jnp.asarray(greedy), jnp.asarray(t),
             jnp.asarray(k), jnp.asarray(p), jnp.asarray(keydata),
+            *self._lora_dispatch_args(tenants),
         )
         if res is None:
             return False
@@ -1764,6 +1919,8 @@ class BatchedDecodeEngine:
                 greedy=req.greedy, t=req.t, k=req.k, p=req.p,
                 keydata=req.keydata, deadline=req.deadline,
                 retries=req.retries, nan_retried=req.nan_retried,
+                tier=req.tier, session=req.session,
+                resub_len=req.resub_len, tenant_slot=req.tenant_slot,
             )
             log_event(
                 "admit", rid=req.rid, row=row, bucket=bucket,
@@ -1783,6 +1940,7 @@ class BatchedDecodeEngine:
         k = np.full((b,), self.cfg.vocab_size, np.int32)
         p = np.full((b,), 2.0, np.float32)
         keydata = np.zeros((b, self._key_words), np.uint32)
+        tenants = np.zeros((b,), np.int32)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue  # free rows decode garbage the host discards
@@ -1792,11 +1950,13 @@ class BatchedDecodeEngine:
             greedy[i] = s.greedy
             t[i], k[i], p[i] = s.t, s.k, s.p
             keydata[i] = s.keydata
+            tenants[i] = s.tenant_slot
         res = self._dispatch(
             "decode_step", params, None, finished, jnp.asarray(toks),
             None, jnp.asarray(pos), jnp.asarray(folds),
             jnp.asarray(greedy), jnp.asarray(t), jnp.asarray(k),
             jnp.asarray(p), jnp.asarray(keydata),
+            *self._lora_dispatch_args(tenants),
         )
         if res is None:
             return
@@ -1968,15 +2128,21 @@ class BatchedDecodeEngine:
         backs a replica) + a copy of the monotonic ``counters``. Pure
         host bookkeeping; never dispatches."""
         free_slots = sum(1 for s in self._slots if s is None)
+        by_tier = {name: 0 for name in PRIORITIES}
+        for q in self._queue:
+            by_tier[TIER_NAME[q.tier]] += 1
         return {
             "engine": type(self).__name__,
             "queue_depth": len(self._queue),
+            "queue_depth_by_tier": by_tier,
             "slots": self.slots,
             "active_rows": self.slots - free_slots,
             "free_slots": free_slots,
             "pool_pages": None,
             "free_pages": None,
             "pages_in_use": None,
+            "session_pinned_pages": None,
+            "sessions": None,
             "prefix_hit_rate": None,
             "kv_quant": "none",
             "counters": dict(self.counters),
@@ -2027,7 +2193,7 @@ class BatchedDecodeEngine:
                 jnp.full((npad,), self.cfg.vocab_size, jnp.int32),
                 jnp.full((npad,), 2.0, jnp.float32),
                 jnp.zeros((npad, self._key_words), jnp.uint32),
-            )
+            ) + self._lora_dispatch_args(np.zeros((npad,), np.int32))
         if kind == "decode_step":
             b = self.slots
             return (
@@ -2041,7 +2207,7 @@ class BatchedDecodeEngine:
                 jnp.full((b,), self.cfg.vocab_size, jnp.int32),
                 jnp.full((b,), 2.0, jnp.float32),
                 jnp.zeros((b, self._key_words), jnp.uint32),
-            )
+            ) + self._lora_dispatch_args(np.zeros((b,), np.int32))
         raise KeyError(f"unknown batched program kind {kind!r}")
 
     def verify_donation(self, params) -> dict[str, dict]:
@@ -2161,6 +2327,8 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         paged_attention: str = "gather",
         kv_quant: str = "none",
         mesh_cfg: MeshConfig | None = None,
+        session_pin_budget_pages: int | None = None,
+        batch_admit_free_frac: float = 0.25,
         **kw,
     ) -> None:
         if page_size < 1 or max_len % page_size:
@@ -2229,6 +2397,32 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         self._paged_impl = paged_attention
         self.kv_quant = _check_quant_arg("kv_quant", kv_quant)
         self.counters["preemptions"] = 0
+        self.counters["preempt_priority"] = 0
+        self.counters["batch_yield_ticks"] = 0
+        if not 0.0 <= batch_admit_free_frac <= 1.0:
+            raise ValueError(
+                f"batch_admit_free_frac must be in [0, 1], got "
+                f"{batch_admit_free_frac} (the free-page fraction below "
+                "which BATCH-tier requests stop admitting)"
+            )
+        self.batch_admit_free_frac = float(batch_admit_free_frac)
+        from pytorch_distributed_tpu.serving.session import SessionTracker
+
+        # Session retention pins at most half the pool by default and
+        # evict_idle sheds loudly past the budget. Pins can still cover
+        # capacity a queued request needs when every pinned session has
+        # a turn in flight (inflight pins are unevictable) — _admit's
+        # no-live-rows go-around below keeps that from stalling the
+        # queue for good.
+        self._sessions = SessionTracker(
+            self.pool,
+            pin_budget_pages=(
+                (self.pool_pages - 1) // 2
+                if session_pin_budget_pages is None
+                else session_pin_budget_pages
+            ),
+            clock=self._clock,
+        )
         log_event(
             "pool_build",
             quant=self.kv_quant,
@@ -2313,16 +2507,23 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             pool_pages=self.pool_pages,
             free_pages=self.pool.free_pages(),
             pages_in_use=self.pool.pages_in_use(),
+            # Session retention's capacity cost: pages held ONLY by a
+            # pin. The router's least-loaded scoring adds these to page
+            # pressure, so a session-heavy replica is deprioritized
+            # BEFORE it starts preempting for its pinned residents.
+            session_pinned_pages=self.pool.pinned_pages(),
+            sessions=len(self._sessions),
             prefix_hit_rate=round(
                 ps["prefix_hits"] / max(1, ps["prefix_queries"]), 4
             ),
             kv_quant=self.kv_quant,
         )
+        out["counters"]["session_evictions"] = self._sessions.evictions
         return out
 
     # -- programs ----------------------------------------------------------
 
-    def _forward_paged(self, params, ids, cache, pos, tables):
+    def _forward_paged(self, params, ids, cache, pos, tables, lora=None):
         kwargs = {
             "block_tables": tables,
             "paged_impl": self._paged_impl,
@@ -2330,6 +2531,8 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         }
         if self.mode == "tp":
             kwargs["tensor_axis"] = "tensor"
+        if lora:
+            kwargs["lora"] = lora
         return decode.forward(params, ids, self.cfg, cache, pos, **kwargs)
 
     def _bodies(self):
@@ -2339,7 +2542,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         shared with the dense bodies so they can never drift."""
 
         def prefill(params, chunks, valid, start, tables, cache,
-                    greedy, t, k, p, keydata):
+                    greedy, t, k, p, keydata, *lora):
             # One CHUNK per row: tokens chunks[:, :valid] run at
             # positions start..start+valid-1 (pad positions write
             # garbage past the write point into the row's own padded
@@ -2347,7 +2550,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             # granularity). The sampled token only matters for rows on
             # their final chunk; the host discards the rest.
             logits, cache = self._forward_paged(
-                params, chunks, cache, start, tables
+                params, chunks, cache, start, tables, lora
             )
             last = jnp.take_along_axis(
                 logits, (valid - 1)[:, None, None], axis=1
@@ -2357,9 +2560,9 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             return tok, decode.nonfinite_rows(last), cache
 
         def decode_step(params, toks, cache, pos, tables, folds,
-                        greedy, t, k, p, keydata):
+                        greedy, t, k, p, keydata, *lora):
             logits, cache = self._forward_paged(
-                params, toks[:, None], cache, pos, tables
+                params, toks[:, None], cache, pos, tables, lora
             )
             last = logits[:, -1]
             keys = jax.vmap(jax.random.fold_in)(
@@ -2395,7 +2598,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                     self._p_specs, P(), cache_spec, P(), P(), P(),
                     P(), P(), P(), P(), P(),
                 ),
-            }[kind]
+            }[kind] + self._lora_in_specs()
             smapped = shard_map(
                 body,
                 mesh=self._mesh,
@@ -2407,27 +2610,212 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         self._programs[kind] = prog
         return prog
 
+    # -- sessions ----------------------------------------------------------
+
+    def open_session(self) -> int:
+        """Open one multi-turn chat session (serving/session.py):
+        returns the sid ``submit(session=)`` takes. Turn N resubmits the
+        conversation-so-far and pays ~one chunk of prefill via the
+        pinned prefix cache; idle sessions past the pin budget are
+        evicted loudly (their next turn pays a cold prefill)."""
+        return self._sessions.open()
+
+    def close_session(self, sid: int) -> None:
+        """Close a session: its pins return to ordinary LRU retention
+        (the chunks may still be hit until evicted). Unknown sids raise."""
+        self._sessions.close(sid)
+
+    def _session_checkin(self, session, prompt) -> int:
+        if session is None:
+            return 0
+        return self._sessions.check_turn(session, prompt)
+
+    def _session_begin(self, session, rid) -> None:
+        if session is not None:
+            self._sessions.begin_turn(session, rid)
+
+    def _finish(self, rid, state, tokens, reason, finished=None) -> None:
+        # Every terminal state clears the session's in-flight marker (a
+        # DONE turn already recorded its transcript via
+        # ``_retire_session_turn``); non-session rids no-op.
+        self._sessions.on_terminal(rid)
+        super()._finish(rid, state, tokens, reason, finished)
+
+    def _retire_session_turn(self, s: _PagedSlot) -> None:
+        """A session turn is retiring DONE: publish its DECODE-written
+        full chunks (prefill already published the prompt's — the K/V
+        of a generated token is the same pure function of its prefix,
+        so these are sound cache entries; MUST run before the row's
+        pages release so retention sees them resident), then hand the
+        tracker the new transcript + the full chain to pin."""
+        toks = self._partial_tokens(s.prompt, s.generated)
+        cp = self.chunk // self.page_size
+        key = s.chain_key  # chain at the last prefill-published boundary
+        for st in range(
+            (s.prefill_len // self.chunk) * self.chunk,
+            (s.pos // self.chunk) * self.chunk,
+            self.chunk,
+        ):
+            first = st // self.page_size
+            key = self.pool.register_chunk(
+                toks, st, s.table[first: first + cp].tolist(),
+                prev_key=key,
+            )
+        self._sessions.on_turn_done(
+            s.session, toks, self.pool.chain_keys(toks, s.pos)
+        )
+
+    def _maybe_retire(self, row: int, finished: list[int]) -> None:
+        s = self._slots[row]
+        hit_eos = s.eos_id is not None and s.generated[-1] == s.eos_id
+        if len(s.generated) < s.max_new and not hit_eos:
+            return
+        if s.session is not None:
+            self._retire_session_turn(s)
+        self._slots[row] = None
+        self._on_slot_freed(s)
+        self._finish_slot(s, DONE, "", finished)
+
     # -- scheduler ---------------------------------------------------------
+
+    def _batch_headroom(self) -> bool:
+        """BATCH-tier admission gate: only while at least
+        ``batch_admit_free_frac`` of the pool is ALLOCATABLE (free or
+        LRU-reclaimable — retired cached prefixes are headroom, not
+        pressure) does throughput traffic admit — a batch backlog fills
+        otherwise-idle capacity but never bids against interactive/
+        standard traffic for a contended pool."""
+        return (
+            self.pool.allocatable_pages()
+            >= self.batch_admit_free_frac * (self.pool_pages - 1)
+        )
 
     def _admit(self, params, finished: list[int]) -> None:
         free = [i for i, s in enumerate(self._slots) if s is None]
-        while free and self._queue:
-            slot = self._try_allocate(self._queue[0])
-            if slot is None:
-                # Head-of-line waits for pages (FIFO stays FIFO); decode
-                # keeps running, retirements free pages — deferral, not
-                # a hang.
+        # The queue is sorted ONCE and the order reused across
+        # admissions (queue_key is static per request, so removals keep
+        # it sorted); only a preemption's requeued victim invalidates
+        # it. Pool state cannot change during a candidate scan, so the
+        # batch-headroom gate — an O(cached-chunks) pool walk — is
+        # evaluated at most once per scan.
+        ordered = None
+        blocked: set[int] = set()
+        while self._queue:
+            # Priority-ordered admission (scheduler.queue_key):
+            # interactive first (earliest deadline within the tier),
+            # then standard/batch in FIFO order — an all-standard queue
+            # admits exactly like the pre-tier engine. BATCH entries are
+            # SKIPPED (not blocking) while the pool lacks headroom.
+            if ordered is None:
+                ordered = sorted(self._queue, key=self._queue_key)
+            req = None
+            headroom = None
+            for cand in ordered:
+                if cand.rid in blocked:
+                    continue
+                if cand.tier == TIER_RANK[BATCH]:
+                    if headroom is None:
+                        headroom = self._batch_headroom()
+                    if not headroom:
+                        continue
+                req = cand
                 break
-            self._queue.popleft()
+            if req is None:
+                break
+            if not free:
+                # No free slot: an INTERACTIVE arrival may preempt a
+                # strictly-lower-priority active row for its slot (and
+                # pages); everyone else waits for a retirement.
+                n0 = len(self._queue)
+                row = self._preempt_lower_priority(req.tier, finished)
+                if len(self._queue) != n0:
+                    ordered = None
+                if row is None:
+                    break
+                free.append(row)
+            slot = self._try_allocate(req)
+            while slot is None:
+                # Page shortage: idle-session pins break FIRST (cheap —
+                # the session just loses retention), then strictly-
+                # lower-priority actives are preempted for their pages.
+                # BATCH never breaks a pin: pinned pages are not the
+                # idle capacity batch is allowed to fill (the router
+                # scores them unavailable for the same reason) — a
+                # batch row this large waits for ordinary retirements.
+                if (
+                    req.tier != TIER_RANK[BATCH]
+                    and self._sessions.evict_idle()
+                ):
+                    slot = self._try_allocate(req)
+                    continue
+                n0 = len(self._queue)
+                row = self._preempt_lower_priority(req.tier, finished)
+                if len(self._queue) != n0:
+                    ordered = None
+                if row is None:
+                    break
+                free.append(row)
+                slot = self._try_allocate(req)
+            if slot is None:
+                # Highest-priority admissible entry waits for pages
+                # (deferral, not a hang): decode keeps running and
+                # retirements free pages. With NO live rows nothing can
+                # ever retire — a head this large would stall the queue
+                # for good when the pages it needs are pinned by
+                # sessions whose own queued turns (the only thing that
+                # releases the pins) sit right behind it — so only then
+                # do later, smaller entries go around it this tick.
+                if any(s is not None for s in self._slots):
+                    break
+                blocked.add(req.rid)
+                continue
+            self._queue.remove(req)
+            if ordered is not None:
+                ordered.remove(req)
             row = free.pop(0)
             self._slots[row] = slot
             log_event(
                 "admit", rid=slot.rid, row=row,
                 cached_tokens=slot.pos or None,
                 resume_prefix=slot.resume_base or None,
+                priority=(
+                    TIER_NAME[slot.tier]
+                    if slot.tier != TIER_RANK[STANDARD] else None
+                ),
+                session=slot.session,
                 t=round(self._clock(), 6),
             )
         self._chunk_prefill_tick(params, finished)
+
+    def _preempt_lower_priority(self, tier: int, finished) -> int | None:
+        """Preempt the lowest-priority-then-youngest active row whose
+        tier is STRICTLY below ``tier`` (admission-side preemption: an
+        interactive arrival takes a batch row's slot/pages regardless of
+        age; standard/batch arrivals never preempt — they wait for a
+        retirement, exactly the pre-tier schedule). Returns the freed
+        row index, or None when the arrival may not preempt or nothing
+        outranked exists."""
+        if tier != TIER_RANK[INTERACTIVE]:
+            return None
+        cands = [
+            (preemption_key(s.tier, s.rid), i)
+            for i, s in enumerate(self._slots)
+            if s is not None and s.tier > tier
+        ]
+        if not cands:
+            return None
+        (_, rid), row = max(cands)
+        s = self._slots[row]
+        self._slots[row] = None
+        self._on_slot_freed(s)
+        self.counters["preempt_priority"] += 1
+        log_event(
+            "preempt_priority", rid=rid, row=row, depth=s.pos,
+            victim_tier=TIER_NAME[s.tier], for_tier=TIER_NAME[tier],
+            t=round(self._clock(), 6),
+        )
+        self._requeue([self._pending_from_slot(s, bump=False)])
+        return row
 
     def _try_allocate(self, req: _Pending) -> _PagedSlot | None:
         """Build a slot for ``req`` if the pool can cover its prefill
@@ -2462,6 +2850,11 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         pids = list(shared) + fresh
         table = np.zeros((self.max_pages,), np.int32)
         table[: len(pids)] = pids
+        if req.session is not None:
+            # First admission of a session turn commits its prefix-hit
+            # economics (preemption re-admissions are de-duplicated by
+            # rid inside the tracker).
+            self._sessions.note_admit(req.rid, cached, req.resub_len)
         return _PagedSlot(
             rid=req.rid, prompt=req.prompt, max_new=req.max_new,
             eos_id=req.eos_id, pos=cached, fold=len(req.gen),
@@ -2469,6 +2862,8 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             t=req.t, k=req.k, p=req.p, keydata=req.keydata,
             deadline=req.deadline, retries=req.retries,
             nan_retried=req.nan_retried,
+            tier=req.tier, session=req.session,
+            resub_len=req.resub_len, tenant_slot=req.tenant_slot,
             prefix=prefix, prefill_len=plen, table=table, pids=pids,
             n_pages=len(pids), prefill_keydata=req.prefill_keydata,
             resume_base=len(req.gen), chain_key=chain_key,
@@ -2483,6 +2878,26 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             (i, s) for i, s in enumerate(self._slots)
             if s is not None and not s.ready
         ]
+        if rows and any(
+            s is not None and s.ready
+            and s.tier == TIER_RANK[INTERACTIVE]
+            for s in self._slots
+        ):
+            # BATCH prefill yields to interactive decode (the prefill
+            # half of the decode-tick yield): while a latency-tier row
+            # is generating, throughput rows do not inflate its ticks
+            # with their chunk prefills. Deliberately NOT while the
+            # interactive row is still mid-prefill: batch prefill
+            # proceeding there keeps its pages held, which is what the
+            # preempt-lowest path reclaims the moment the latency row
+            # needs them. Bounded: interactive rows retire within
+            # max_new ticks, then the backlog streams in. Standard rows
+            # are untouched (the all-STANDARD schedule stays the
+            # pre-tier one).
+            rows = [
+                (i, s) for i, s in rows
+                if s.tier != TIER_RANK[BATCH]
+            ]
         if not rows:
             return
         n = len(rows)
@@ -2497,6 +2912,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         k = np.full((npad,), self.cfg.vocab_size, np.int32)
         p = np.full((npad,), 2.0, np.float32)
         keydata = np.zeros((npad, self._key_words), np.uint32)
+        tenants = np.zeros((npad,), np.int32)
         for j, ii in enumerate(idx):
             _, s = rows[ii]
             v = min(self.chunk, s.prefill_len - s.pos)
@@ -2507,12 +2923,14 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             greedy[j] = s.greedy
             t[j], k[j], p[j] = s.t, s.k, s.p
             keydata[j] = s.prefill_keydata
+            tenants[j] = s.tenant_slot
         res = self._dispatch(
             "prefill", params, [], finished,
             jnp.asarray(chunks), jnp.asarray(valid), jnp.asarray(start),
             jnp.asarray(tables), None, jnp.asarray(greedy),
             jnp.asarray(t), jnp.asarray(k), jnp.asarray(p),
             jnp.asarray(keydata),
+            *self._lora_dispatch_args(tenants),
         )
         if res is None:
             return  # recovery converted every in-flight row already
@@ -2543,11 +2961,34 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 self._maybe_retire(row, finished)
 
     def _decode_tick(self, params, finished: list[int]) -> None:
-        self._ensure_decode_pages(finished)
-        ready = [
-            (i, s) for i, s in enumerate(self._slots)
-            if s is not None and s.ready
-        ]
+        # BATCH decode yields to a live interactive row (the decode
+        # half of the chunk-prefill yield below): while a latency-tier
+        # request occupies a slot, throughput rows sit out the tick —
+        # their lanes stay zeroed (table 0 -> the scratch page), so the
+        # interactive tick's working set shrinks to the latency rows'
+        # own pages instead of streaming every batch row's cache
+        # through it. A skipped tick recomputes nothing (the row's
+        # operands are a pure function of its own state), so batch
+        # tokens stay bit-equal — just later. Bounded: interactive
+        # rows retire within max_new ticks, then batch streams again.
+        # STANDARD rows never yield (the all-STANDARD schedule is the
+        # pre-tier one).
+        interactive_live = any(
+            s is not None and s.tier == TIER_RANK[INTERACTIVE]
+            for s in self._slots
+        )
+        self._ensure_decode_pages(finished, skip_batch=interactive_live)
+        ready = []
+        yielded = False
+        for i, s in enumerate(self._slots):
+            if s is None or not s.ready:
+                continue
+            if interactive_live and s.tier == TIER_RANK[BATCH]:
+                yielded = True
+                continue
+            ready.append((i, s))
+        if yielded:
+            self.counters["batch_yield_ticks"] += 1
         if not ready:
             return
         b = self.slots
@@ -2560,10 +3001,11 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         k = np.full((b,), self.cfg.vocab_size, np.int32)
         p = np.full((b,), 2.0, np.float32)
         keydata = np.zeros((b, self._key_words), np.uint32)
+        tenants = np.zeros((b,), np.int32)
         for i, s in ready:
             # Free AND mid-prefill rows stay all-zero: table 0 -> the
             # scratch page, so their garbage write/read never touches a
-            # live row's pages.
+            # live row's pages (and slot 0 is the zero adapter).
             toks[i] = s.generated[-1]
             pos[i] = s.pos
             tables[i] = s.table
@@ -2571,18 +3013,18 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             greedy[i] = s.greedy
             t[i], k[i], p[i] = s.t, s.k, s.p
             keydata[i] = s.keydata
+            tenants[i] = s.tenant_slot
         res = self._dispatch(
             "decode_step", params, None, finished, jnp.asarray(toks),
             None, jnp.asarray(pos), jnp.asarray(tables),
             jnp.asarray(folds), jnp.asarray(greedy), jnp.asarray(t),
             jnp.asarray(k), jnp.asarray(p), jnp.asarray(keydata),
+            *self._lora_dispatch_args(tenants),
         )
         if res is None:
             return
         out, bad = res
-        for i, s in enumerate(self._slots):
-            if s is None or not s.ready:
-                continue
+        for i, s in ready:
             if bad[i]:
                 self._slots[i] = None
                 self._on_slot_freed(s)
@@ -2593,12 +3035,16 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             s.fold += 1
             self._maybe_retire(i, finished)
 
-    def _ensure_decode_pages(self, finished: list[int]) -> None:
+    def _ensure_decode_pages(
+        self, finished: list[int], skip_batch: bool = False
+    ) -> None:
         """Grow each decode-ready row's table to cover its next write.
         Pool exhaustion preempts the YOUNGEST other active request
         (admitted last -> preempted first): its clean prefix requeues as
         a resume entry — no retry charge, no token loss — and its pages
-        come back to the pool."""
+        come back to the pool. ``skip_batch``: batch rows yielding this
+        tick don't advance, so growing their tables now could only fire
+        a needless preemption under pressure."""
         for i in range(self.slots):
             # Read the LIVE slot list each iteration: a preemption fired
             # for an earlier row may have freed this one, and growing a
@@ -2606,6 +3052,8 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             # row to feed a corpse).
             s = self._slots[i]
             if s is None or not s.ready:
+                continue
+            if skip_batch and s.tier == TIER_RANK[BATCH]:
                 continue
             if s.pos // self.page_size < s.n_pages:
                 continue
@@ -2615,6 +3063,22 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                     s.table[s.n_pages] = got[0]
                     s.pids += got
                     s.n_pages += 1
+                    break
+                # Retention must never deadlock allocation: idle-session
+                # pins break (loudly) before any live row is preempted.
+                if self._sessions.evict_idle():
+                    continue
+                others = [
+                    o.tier for o in self._slots
+                    if o is not None and o.rid != s.rid
+                ]
+                if others and max(others) < s.tier:
+                    # Every neighbour strictly outranks this row: IT is
+                    # the lowest-priority occupant, so it yields its own
+                    # pages (a batch row must never evict interactive
+                    # state to keep growing) — clean resume entry, like
+                    # any other preemption.
+                    self._preempt_row(i)
                     break
                 if not self._preempt_one(exclude_rid=s.rid, finished=finished):
                     from pytorch_distributed_tpu.serving.lifecycle import (
@@ -2630,24 +3094,39 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                     )
 
     def _preempt_one(self, *, exclude_rid: int, finished) -> bool:
+        # Preempt-lowest-priority-then-youngest (scheduler.py): the
+        # victim is the active row with the MAX (tier rank, rid) — a
+        # batch row goes before an interactive row regardless of age,
+        # and an all-STANDARD batch recovers PR-8's preempt-youngest
+        # exactly.
         cands = [
-            (s.rid, i) for i, s in enumerate(self._slots)
+            (preemption_key(s.tier, s.rid), i)
+            for i, s in enumerate(self._slots)
             if s is not None and s.rid != exclude_rid
         ]
         if not cands:
             return False
-        rid, row = max(cands)  # youngest = submitted last
+        self._preempt_row(max(cands)[1])
+        return True
+
+    def _preempt_row(self, row: int) -> None:
+        """Convert one active row to a clean resume entry (no retry
+        charge, pages released) — the shared tail of every preemption
+        path."""
         s = self._slots[row]
         self._slots[row] = None
         self._on_slot_freed(s)
         self.counters["preemptions"] += 1
         log_event(
-            "preempt", rid=rid, row=row, depth=s.pos,
+            "preempt", rid=s.rid, row=row, depth=s.pos,
             generated=len(s.generated) - s.resume_base,
+            tier=(
+                TIER_NAME[s.tier]
+                if s.tier != TIER_RANK[STANDARD] else None
+            ),
             t=round(self._clock(), 6),
         )
         self._requeue([self._pending_from_slot(s, bump=False)])
-        return True
 
     def _on_slot_freed(self, s: _Slot) -> None:
         self.pool.release(s.pids)
@@ -2664,6 +3143,9 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             if s is not None:
                 s.pids = []
         self.pool.reset()
+        # Every pinned chunk's content died with the pool: drop the
+        # pins (transcripts survive — the next turn re-pays prefill).
+        self._sessions.on_pool_reset()
         super()._recover_dispatch_failure(
             kind, err, group_pendings, finished
         )
@@ -2712,7 +3194,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 jnp.full((npad,), self.cfg.vocab_size, jnp.int32),
                 jnp.full((npad,), 2.0, jnp.float32),
                 jnp.zeros((npad, self._key_words), jnp.uint32),
-            )
+            ) + self._lora_dispatch_args(np.zeros((npad,), np.int32))
         if kind == "decode_step":
             b = self.slots
             return (
@@ -2727,7 +3209,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 jnp.full((b,), self.cfg.vocab_size, jnp.int32),
                 jnp.full((b,), 2.0, jnp.float32),
                 jnp.zeros((b, self._key_words), jnp.uint32),
-            )
+            ) + self._lora_dispatch_args(np.zeros((b,), np.int32))
         raise KeyError(f"unknown batched program kind {kind!r}")
 
 

@@ -170,12 +170,20 @@ class ReplicaRouter:
         # Entries with no live replica to run them: (router rid,
         # _Pending). Re-adopted at the next tick with a routable replica.
         self._orphans: list[tuple[int, Any]] = []
+        # Session stickiness: router sid -> (rep_id, engine sid). Turns
+        # of one session must land on the replica holding its pinned
+        # prefix pages; on replica loss the session re-homes to a
+        # survivor (fresh engine sid — the transcript-carrying
+        # resubmission makes that lossless, at one cold prefill).
+        self._sessions: dict[int, tuple[int, int]] = {}
+        self._next_sid = 0
         self.results: dict[int, RequestResult] = {}
         self._ticks = 0
         self._injector = None  # serving/chaos.RouterFaultInjector
         self.counters: dict[str, int] = {
             "routed": 0, "shed": 0, "failovers": 0, "failover_requests": 0,
             "drains": 0, "restarts": 0, "orphaned": 0,
+            "sessions_opened": 0, "session_rehomes": 0,
         }
 
     # -- fleet management ---------------------------------------------------
@@ -229,7 +237,15 @@ class ReplicaRouter:
         if st["free_pages"] is not None:
             if st["free_pages"] < self.shed_page_free:
                 return None
-            page_pressure = st["pages_in_use"] / max(1, st["pool_pages"])
+            # Session-pinned pages count as UNAVAILABLE capacity: they
+            # are off the allocator's table until their session goes
+            # idle, so a session-heavy replica must look loaded before
+            # it starts preempting for its pinned residents
+            # (regression-pinned in tests/test_serving_scenarios.py).
+            pinned = st.get("session_pinned_pages") or 0
+            page_pressure = (
+                st["pages_in_use"] + pinned
+            ) / max(1, st["pool_pages"])
         load = st["queue_depth"] + st["active_rows"]
         return (
             1.0 if r.state == DEGRADED else 0.0,
@@ -267,29 +283,147 @@ class ReplicaRouter:
         )
         return max(self.retry_after_s, med * (depth + 1))
 
-    def submit(self, prompt, max_new_tokens: int, **kw) -> int:
+    def open_session(self) -> int:
+        """Open a multi-turn session on the least-loaded routable
+        replica (it must be paged — sessions ride the pinned prefix
+        cache); returns the ROUTER session id ``submit(session=)``
+        takes. The router owns the sid -> (replica, engine sid)
+        stickiness map and re-homes the session to a survivor on
+        replica loss."""
+        best = self._least_loaded()
+        if best is None:
+            raise RouterOverloaded(
+                "no live replica to open a session on "
+                f"(states {self.replica_states()})",
+                retry_after_s=self._retry_after(),
+            )
+        if not hasattr(best.engine, "open_session"):
+            raise ValueError(
+                "sessions need paged replica engines "
+                "(PagedBatchedDecodeEngine) — this fleet serves "
+                f"{type(best.engine).__name__}"
+            )
+        esid = best.engine.open_session()
+        sid = self._next_sid
+        self._next_sid += 1
+        self._sessions[sid] = (best.rep_id, esid)
+        self.counters["sessions_opened"] += 1
+        log_event(
+            "session_route", session=sid, replica=best.rep_id,
+            engine_session=esid, t=round(self._clock(), 6),
+        )
+        return sid
+
+    def close_session(self, sid: int) -> None:
+        """Close a router session; the replica's pins release. Unknown
+        sids raise (loudly, like the engine's own close)."""
+        loc = self._sessions.pop(sid, None)
+        if loc is None:
+            raise ValueError(
+                f"unknown router session id {sid}: open_session() "
+                "first (or it was already closed)"
+            )
+        rep_id, esid = loc
+        r = self._replicas[rep_id]
+        if r.state in _ROUTABLE:
+            r.engine.close_session(esid)
+        # A DOWN/DRAINED holder's tracker died (or will be rebuilt)
+        # with its engine — nothing to release.
+
+    def _session_target(self, sid: int) -> tuple[_Replica, int]:
+        """The (replica, engine sid) a session turn must route to,
+        re-homing onto a survivor when the sticky replica is not
+        routable — a fresh engine session whose empty transcript any
+        resubmitted conversation extends (one cold prefill, no data
+        loss, counted as ``session_rehomes``)."""
+        loc = self._sessions.get(sid)
+        if loc is None:
+            raise ValueError(
+                f"unknown router session id {sid}: open_session() "
+                "first (or it was closed)"
+            )
+        rep_id, esid = loc
+        r = self._replicas[rep_id]
+        if r.state in _ROUTABLE:
+            return r, esid
+        best = self._least_loaded()
+        if best is None:
+            raise RouterOverloaded(
+                f"session {sid}'s replica {rep_id} is {r.state} and no "
+                "survivor can re-home it",
+                retry_after_s=self._retry_after(),
+            )
+        esid = best.engine.open_session()
+        self._sessions[sid] = (best.rep_id, esid)
+        self.counters["session_rehomes"] += 1
+        log_event(
+            "session_route", session=sid, replica=best.rep_id,
+            engine_session=esid, rehomed_from=rep_id,
+            t=round(self._clock(), 6),
+        )
+        return best, esid
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               session: int | None = None, **kw) -> int:
         """Route one request (``engine.submit`` kwargs pass through —
-        deadlines via ``timeout_s=`` land on the replica engine's
-        clock). Returns the ROUTER rid its terminal ``RequestResult``
-        will carry in ``results`` / ``pop_result``. Raises
-        ``RouterOverloaded`` (with ``retry_after_s``) when no replica is
-        admissible."""
+        deadlines via ``timeout_s=``, SLO tiers via ``priority=`` and
+        tenants via ``tenant=`` land on the replica engine). Returns
+        the ROUTER rid its terminal ``RequestResult`` will carry in
+        ``results`` / ``pop_result``. Raises ``RouterOverloaded`` (with
+        ``retry_after_s``) when no replica is admissible.
+
+        ``session=`` (a router sid from ``open_session``) routes STICKY
+        to the replica holding the session's pinned pages instead of
+        least-loaded — the pages ARE the locality."""
         from pytorch_distributed_tpu.serving.lifecycle import (
             AdmissionQueueFull,
         )
 
         r = erid = None
-        for cand in self._ranked_replicas():
+        if session is not None:
+            r, esid = self._session_target(session)
+            if self._admissible(r) is None:
+                # Stickiness cannot spill to another replica (the pages
+                # live here), but the SLO gate still applies: past the
+                # router's shed thresholds the holder sheds like a
+                # saturated fleet — without this, an engine with
+                # queue_limit=None would let session turns queue
+                # unboundedly while plain traffic is 429'd.
+                self.counters["shed"] += 1
+                hint = self._retry_after()
+                raise RouterOverloaded(
+                    f"session {session}'s replica {r.rep_id} is past "
+                    f"its admission threshold; retry after "
+                    f"~{hint:.2f}s",
+                    retry_after_s=hint,
+                )
             try:
-                erid = cand.engine.submit(prompt, max_new_tokens, **kw)
-                r = cand
-                break
-            except AdmissionQueueFull:
-                # The engine's own queue_limit can be tighter than the
-                # router's threshold — that replica is saturated, try
-                # the next; all-saturated sheds below like any other
-                # overload.
-                continue
+                erid = r.engine.submit(
+                    prompt, max_new_tokens, session=esid, **kw
+                )
+            except AdmissionQueueFull as err:
+                # Stickiness cannot spill to another replica (the pages
+                # live here): a saturated holder sheds like a saturated
+                # fleet.
+                self.counters["shed"] += 1
+                hint = self._retry_after()
+                raise RouterOverloaded(
+                    f"session {session}'s replica {r.rep_id} is "
+                    f"saturated ({err}); retry after ~{hint:.2f}s",
+                    retry_after_s=hint,
+                ) from None
+        else:
+            for cand in self._ranked_replicas():
+                try:
+                    erid = cand.engine.submit(prompt, max_new_tokens, **kw)
+                    r = cand
+                    break
+                except AdmissionQueueFull:
+                    # The engine's own queue_limit can be tighter than
+                    # the router's threshold — that replica is
+                    # saturated, try the next; all-saturated sheds
+                    # below like any other overload.
+                    continue
         if r is None:
             self.counters["shed"] += 1
             hint = self._retry_after()
@@ -651,6 +785,23 @@ class ReplicaRouter:
         r.state = HEALTHY
         r.tick_ema_s = None
         r.down_reason = ""
+        # Router sessions still homed here point at the OLD engine's
+        # sids — the fresh engine restarts its session counter, so a
+        # stale esid would either read as unknown or collide with a
+        # later open_session(). Re-home each onto a fresh engine session
+        # on this replica (empty transcript; the next turn's resubmitted
+        # conversation extends it — one cold prefill, no data loss).
+        for sid, (home, _stale) in list(self._sessions.items()):
+            if home != rep_id:
+                continue
+            esid = r.engine.open_session()
+            self._sessions[sid] = (rep_id, esid)
+            self.counters["session_rehomes"] += 1
+            log_event(
+                "session_route", session=sid, replica=rep_id,
+                engine_session=esid, rehomed_from=rep_id,
+                t=round(self._clock(), 6),
+            )
         self.counters["restarts"] += 1
         log_event(
             "replica_up", replica=rep_id, t=round(self._clock(), 6),
@@ -680,5 +831,6 @@ class ReplicaRouter:
             },
             "orphans": len(self._orphans),
             "undelivered_results": len(self.results),
+            "sessions": len(self._sessions),
             "counters": dict(self.counters),
         }

@@ -1,0 +1,89 @@
+"""SLO priority tiers for the serving engines: the workload vocabulary.
+
+PR-8's scheduler treats every request identically: FIFO admission,
+preempt-youngest under page pressure. Real serving traffic is not one
+workload — an interactive chat turn and an overnight batch-evaluation
+request have different SLOs, and a scheduler that cannot tell them
+apart either wastes capacity (provision for batch at interactive p99)
+or breaks promises (interactive latency collapses whenever batch
+saturates the pool). This module is the tier vocabulary and the
+ordering rules; `serving/engine.py` applies them. Everything here is
+HOST-SIDE scheduler policy: tiers never reach a traced program, so the
+zero-steady-state-compile / strict-donation / rows-invariant-collective
+contracts are untouched by construction.
+
+Three classes, ranked (lower rank = higher priority):
+
+- ``INTERACTIVE`` (0) — latency-sensitive. Sorts ahead of everything in
+  the admission queue (the "bypass the FIFO head" behaviour), is
+  ordered deadline-first WITHIN the tier (earliest deadline admits
+  first — the only tier where deadline ordering matters, and the only
+  one where reordering is worth deviating from FIFO determinism), and
+  may PREEMPT strictly-lower-priority active rows for a slot or for
+  pages at admission.
+- ``STANDARD`` (1) — the default. Exactly PR-8's behaviour: strict FIFO
+  within the tier; an all-STANDARD stream schedules bit-identically to
+  the pre-tier engine (regression-pinned).
+- ``BATCH`` (2) — throughput traffic. Admits only while the page pool
+  has free headroom (``batch_admit_free_frac``), so a batch backlog
+  fills otherwise-idle capacity but never bids against interactive
+  traffic for a contended pool; first in line for preemption; and its
+  rows YIELD to a live interactive row — decode lanes sit the tick out
+  (zeroed to the scratch page, so the latency row's tick streams only
+  its own pages) and chunk prefills stay out of interactive decode
+  ticks. A yielded tick recomputes nothing, so batch tokens stay
+  bit-equal their unyielded schedule — delayed, never diverged; batch
+  progress resumes the moment no interactive row is live (interactive
+  rows retire within ``max_new`` ticks, so the stall is bounded per
+  burst — sustained interactive saturation SHOULD starve batch, that
+  is the tier's meaning).
+
+Preemption generalizes PR-8's preempt-youngest to
+**preempt-lowest-priority-then-youngest**: the victim is the active row
+with the MAXIMUM ``(tier_rank, rid)`` — a batch row is preempted before
+an interactive row regardless of age, and within a tier the youngest
+goes first (PR-8's rule, recovered exactly when every row is STANDARD).
+"""
+
+from __future__ import annotations
+
+INTERACTIVE = "interactive"
+STANDARD = "standard"
+BATCH = "batch"
+PRIORITIES = (INTERACTIVE, STANDARD, BATCH)
+TIER_RANK = {name: rank for rank, name in enumerate(PRIORITIES)}
+TIER_NAME = {rank: name for rank, name in enumerate(PRIORITIES)}
+
+
+def check_priority(priority: str) -> int:
+    """Priority-class name -> tier rank, rejecting unknown classes
+    loudly (every submit entry point — engine, router, HTTP 400 — runs
+    through here, so the diagnostic is uniform)."""
+    rank = TIER_RANK.get(priority)
+    if rank is None:
+        raise ValueError(
+            f"unknown priority class {priority!r}: expected one of "
+            f"{PRIORITIES} (lower-latency tiers admit first; 'standard' "
+            "is the untier'd default)"
+        )
+    return rank
+
+
+def queue_key(tier: int, deadline: float | None, rid: int):
+    """Admission-queue sort key: tier rank first, then — INTERACTIVE
+    only — earliest deadline, then rid (= submit order). STANDARD/BATCH
+    stay strict FIFO within their tier, so an all-default stream keeps
+    the exact pre-tier schedule and the fault-resume rid-merge stays
+    deterministic."""
+    dl = (
+        deadline
+        if tier == TIER_RANK[INTERACTIVE] and deadline is not None
+        else float("inf")
+    )
+    return (tier, dl, rid)
+
+
+def preemption_key(tier: int, rid: int):
+    """Victim-selection key: the active row with the MAX key is
+    preempted first (lowest priority, then youngest)."""
+    return (tier, rid)

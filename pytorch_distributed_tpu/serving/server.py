@@ -14,7 +14,13 @@ Endpoints:
 
 - ``POST /v1/generate`` — body ``{"prompt": [ids...],
   "max_new_tokens": n, "temperature"?, "top_k"?, "top_p"?, "seed"?,
-  "eos_id"?, "timeout_s"?, "stream"?}``. The client deadline
+  "eos_id"?, "timeout_s"?, "stream"?, "priority"?, "tenant"?,
+  "session"?}``. ``priority`` is the SLO tier
+  (interactive/standard/batch — serving/scheduler.py), ``tenant`` a
+  registered LoRA adapter id (serving/adapters.py), ``session`` a sid
+  from ``/v1/session/open``; unknown priority classes, unregistered
+  tenants, and diverged session resubmissions all reject 400 with the
+  engine's diagnostic. The client deadline
   ``timeout_s`` maps straight onto ``submit(timeout_s=)`` — the engine
   clock enforces it queued AND mid-decode. Plain requests block until
   terminal and return ``{"rid", "state", "tokens", "reason"}``; with
@@ -24,6 +30,10 @@ Endpoints:
   disconnects mid-stream ABORTS its request (the router frees the row;
   neighbours never notice).
 - ``POST /v1/abort`` — ``{"rid": n}`` -> ``{"aborted": bool}``.
+- ``POST /v1/session/open`` -> ``{"session": sid}`` /
+  ``POST /v1/session/close`` ``{"session": sid}`` — the multi-turn
+  chat surface: the router pins the session to one replica (its pages
+  are the locality) and re-homes it on failover.
 - ``GET /healthz`` — the router's ``stats()`` snapshot (replica states,
   queue/page pressure, counters): the probe a load balancer or an
   operator polls.
@@ -258,6 +268,35 @@ class ServingServer:
             if method != "POST":
                 raise _HTTPError(405, "abort is POST")
             await self._abort(body or {}, writer)
+        elif path == "/v1/session/open":
+            if method != "POST":
+                raise _HTTPError(405, "session/open is POST")
+            try:
+                sid = await self._router_call(self.router.open_session)
+            except RouterOverloaded as err:
+                retry = err.retry_after_s or 1.0
+                await self._send_json(
+                    writer, 429,
+                    {"error": str(err), "retry_after_s": retry},
+                    extra_headers=(f"Retry-After: {math.ceil(retry)}",),
+                )
+                return
+            except ValueError as err:  # non-paged fleet rejects loudly
+                raise _HTTPError(400, str(err)) from None
+            await self._send_json(writer, 200, {"session": sid})
+        elif path == "/v1/session/close":
+            sid = (body or {}).get("session")
+            if method != "POST":
+                raise _HTTPError(405, "session/close is POST")
+            if not isinstance(sid, int):
+                raise _HTTPError(400, "close needs an integer session")
+            try:
+                await self._router_call(self.router.close_session, sid)
+            except ValueError as err:  # unknown sid
+                raise _HTTPError(404, str(err)) from None
+            await self._send_json(
+                writer, 200, {"session": sid, "closed": True}
+            )
         elif path.startswith("/admin/"):
             if method != "POST":
                 raise _HTTPError(405, "admin actions are POST")
@@ -275,9 +314,20 @@ class ServingServer:
             )
         max_new = int(body.get("max_new_tokens", self.default_max_new))
         kw: dict = {}
-        for k in ("temperature", "top_k", "top_p", "eos_id", "timeout_s"):
+        for k in ("temperature", "top_k", "top_p", "eos_id", "timeout_s",
+                  "priority", "tenant", "session"):
             if body.get(k) is not None:
                 kw[k] = body[k]
+        if "session" in kw and not isinstance(kw["session"], int):
+            raise _HTTPError(
+                400, "session must be an integer sid from "
+                     "POST /v1/session/open"
+            )
+        if "priority" in kw and not isinstance(kw["priority"], str):
+            raise _HTTPError(
+                400, "priority must be one of "
+                     "'interactive'/'standard'/'batch'"
+            )
         if kw.get("temperature"):
             # "seed" is optional on the wire: a sampled request without
             # one draws a fresh seed here rather than surfacing the

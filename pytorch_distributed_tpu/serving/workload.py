@@ -100,6 +100,94 @@ def request_stream(
     return reqs
 
 
+def tiered_stream(
+    seed: int,
+    *,
+    vocab_size: int,
+    tiers: dict[str, dict],
+) -> list[dict]:
+    """Mixed-SLO arrival stream: ``tiers`` maps a priority class name
+    (serving/scheduler.py) -> ``request_stream`` kwargs (``n``,
+    ``prompt_len``, ``max_new``, ...). Entries carry ``priority=`` and
+    interleave proportionally by index, so one submit loop drives the
+    whole mix and every scheduler batch window sees all tiers.
+
+    Each tier's content derives from ``(seed, tier name)`` ALONE —
+    adding or dropping a tier never changes another tier's prompts,
+    keys, or sampling draws. That independence is what makes the
+    scenarios bench's "interactive p99 loaded vs unloaded" a
+    request-for-request comparison: the unloaded leg replays the
+    interactive tier's EXACT requests without the batch flood."""
+    import zlib
+
+    from pytorch_distributed_tpu.serving.scheduler import check_priority
+
+    tagged: list[tuple[float, int, int, dict]] = []
+    for tier, kw in tiers.items():
+        check_priority(tier)
+        # Stable per-tier substream: crc32(tier) + seed, untouched by
+        # the other tiers (a shared parent rng would re-order draws).
+        sub = np.random.default_rng([zlib.crc32(tier.encode()), seed])
+        reqs = request_stream(sub, vocab_size=vocab_size, **kw)
+        for i, r in enumerate(reqs):
+            r["priority"] = tier
+            # Fractional position in the tier -> global interleave
+            # order; rank-then-index tiebreak keeps it deterministic.
+            tagged.append(
+                ((i + 0.5) / len(reqs), check_priority(tier), i, r)
+            )
+    return [r for *_, r in sorted(tagged, key=lambda e: e[:3])]
+
+
+def session_stream(
+    rng: np.random.Generator,
+    *,
+    n_sessions: int,
+    turns: int,
+    vocab_size: int,
+    open_len: tuple[int, int],
+    turn_len: tuple[int, int],
+    max_new: int | tuple[int, int],
+    sampling_cycle=DEFAULT_SAMPLING_CYCLE,
+    key_seed: int | None = None,
+) -> list[list[dict]]:
+    """The seeded multi-turn chat schedule: ``n_sessions`` scripts of
+    ``turns`` turn dicts each. A turn dict is ``{"tail": [t] int32
+    tokens, "max_new_tokens": n, <sampling kwargs>}`` — the driver
+    (bench leg, soak, tests) submits ``concat(recorded transcript,
+    tail)`` as the turn's prompt, which is exactly the
+    conversation-so-far-plus-new-message shape ``submit(session=)``
+    validates. Turn 1's tail draws ``open_len`` tokens, later turns
+    draw ``turn_len``; per-turn keys are
+    ``fold_in(key(key_seed), session * turns + turn)`` (the PR-11
+    fold_in discipline, one base key for the whole schedule)."""
+    import jax
+
+    if key_seed is None:
+        key_seed = int(rng.integers(0, 2**31 - 1))
+    base_key = None
+    sessions: list[list[dict]] = []
+    for s in range(n_sessions):
+        script: list[dict] = []
+        for t in range(turns):
+            lo, hi = open_len if t == 0 else turn_len
+            tail = rng.integers(
+                0, vocab_size, (int(rng.integers(lo, hi + 1)),)
+            ).astype(np.int32)
+            mn = (
+                int(max_new) if isinstance(max_new, int)
+                else int(rng.integers(max_new[0], max_new[1] + 1))
+            )
+            kw = dict(sampling_cycle[(s * turns + t) % len(sampling_cycle)])
+            if kw.get("temperature"):
+                if base_key is None:
+                    base_key = jax.random.key(key_seed)
+                kw["key"] = jax.random.fold_in(base_key, s * turns + t)
+            script.append(dict(tail=tail, max_new_tokens=mn, **kw))
+        sessions.append(script)
+    return sessions
+
+
 def exponential_arrivals(
     rng: np.random.Generator, n: int, mean_interarrival_s: float,
     start: float = 0.0,

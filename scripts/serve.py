@@ -49,6 +49,16 @@ def main() -> int:
     ap.add_argument("--queue-limit", type=int, default=None,
                     help="per-replica engine admission bound (the router "
                          "sheds above 2x slots per replica regardless)")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="register N LoRA tenants (tenant-0..tenant-N-1, "
+                         "random nonzero factors — a real deployment "
+                         "loads trained ones) on ONE shared registry so "
+                         "/v1/generate accepts \"tenant\"; 0 = no "
+                         "adapters")
+    ap.add_argument("--lora-rank", type=int, default=8,
+                    help="shared low-rank adapter rank (one rank for "
+                         "every tenant — per-tenant ranks would be "
+                         "per-tenant compiles)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8077)
     ap.add_argument("--seed", type=int, default=0)
@@ -104,6 +114,28 @@ def main() -> int:
 
     max_new_cap = min(args.max_new_default * 4, args.max_len // 2)
 
+    # ONE registry shared by every replica: tenant slots stay
+    # consistent across failover adoption (serving/adapters.py).
+    registry = None
+    if args.tenants:
+        from pytorch_distributed_tpu.serving.adapters import (
+            AdapterRegistry,
+        )
+
+        registry = AdapterRegistry(
+            cfg, rank=args.lora_rank, max_tenants=args.tenants
+        )
+        for i in range(args.tenants):
+            registry.register(
+                f"tenant-{i}",
+                key=jax.random.fold_in(jax.random.key(args.seed), i),
+            )
+        print(
+            f"registered {args.tenants} LoRA tenants "
+            f"(rank={args.lora_rank}): "
+            + ", ".join(registry.tenants()), file=sys.stderr,
+        )
+
     def make_engine(rep_id: int):
         if args.dense:
             return BatchedDecodeEngine(
@@ -111,11 +143,12 @@ def main() -> int:
                 buckets=BucketSpec.powers_of_two(
                     args.max_len - max_new_cap, min_bucket=16
                 ),
-                queue_limit=args.queue_limit,
+                queue_limit=args.queue_limit, adapters=registry,
             )
         return PagedBatchedDecodeEngine(
             cfg, slots=args.slots, max_len=args.max_len,
             page_size=args.page_size, queue_limit=args.queue_limit,
+            adapters=registry,
         )
 
     router = ReplicaRouter(make_engine, args.replicas)

@@ -47,25 +47,38 @@ from _common import setup_platform  # noqa: F401  (sys.path side effect)
 
 def _build_requests(rng, cfg, n_req, max_len, *, key_seeds,
                     deadline_range=(0.5, 4.0)):
-    """The seeded request schedule, from the ONE shared generator
-    (serving/workload.py) every bench/soak/loadgen leg consumes.
-    Shared VERBATIM by the chaos and fault-free legs. A third of the
-    stream carries a deadline tight enough that the injected slow_tick
-    stalls expire some of them (virtual time — the fault-free leg's
-    clock never advances, so ITS deadlines never fire and the all-DONE
-    reference stays intact)."""
-    from pytorch_distributed_tpu.serving.workload import request_stream
+    """The seeded request schedule, from the shared generators
+    (serving/workload.py) every bench/soak/loadgen leg consumes —
+    since PR 13 a TIERED mix (1/4 interactive, 1/2 standard, 1/4
+    batch via ``tiered_stream``), so priority-ordered admission runs
+    under the fault storm too, with each tier's content folded from
+    (seed, tier) alone. Shared VERBATIM by the chaos and fault-free
+    legs. A third of the stream carries a deadline tight enough that
+    the injected slow_tick stalls expire some of them (virtual time —
+    the fault-free leg's clock never advances, so ITS deadlines never
+    fire and the all-DONE reference stays intact)."""
+    from pytorch_distributed_tpu.serving.workload import tiered_stream
 
-    return request_stream(
-        rng, n=n_req, vocab_size=cfg.vocab_size, prompt_len=(3, 16),
-        max_new=(1, 8),
+    n_i = n_req // 4
+    n_b = n_req // 4
+    base = dict(
+        prompt_len=(3, 16), max_new=(1, 8),
         sampling_cycle=(
             dict(temperature=0.9, top_k=17),
             dict(temperature=1.1, top_p=0.9),
             dict(),
         ),
-        key_seed=key_seeds, p_deadline=0.33,
-        deadline_range=deadline_range,
+        p_deadline=0.33, deadline_range=deadline_range,
+    )
+    return tiered_stream(
+        int(key_seeds), vocab_size=cfg.vocab_size,
+        tiers={
+            "interactive": dict(n=n_i, key_seed=key_seeds, **base),
+            "standard": dict(
+                n=n_req - n_i - n_b, key_seed=key_seeds + 1, **base
+            ),
+            "batch": dict(n=n_b, key_seed=key_seeds + 2, **base),
+        },
     )
 
 
@@ -344,7 +357,10 @@ def main() -> int:
         args.engine_loss_tick = min(args.engine_loss_tick, 20)
         args.p_dispatch_error = max(args.p_dispatch_error, 0.08)
         args.p_drop_result = max(args.p_drop_result, 0.08)
-        args.p_nan_row = max(args.p_nan_row, 0.15)
+        # nan_row draws only on decode_step dispatches (~15 of the
+        # smoke's ~26 ticks), so its floor is the highest — at 0.15
+        # the tiered schedule's draw sequence left it unfired.
+        args.p_nan_row = max(args.p_nan_row, 0.3)
         args.p_slow_tick = max(args.p_slow_tick, 0.25)
         args.p_abort = max(args.p_abort, 0.2)
         args.deadline_range = (0.3, 1.5)
