@@ -211,6 +211,7 @@ def _build_batched_engine(
     budget_case: str | None = None,
     weight_quant: str = "none",
     lora_rank: int | None = None,
+    speculative_k: int = 0,
     audit_extra: dict | None = None,
 ):
     """A slot-batched serving program (serving/engine.BatchedDecodeEngine):
@@ -234,6 +235,7 @@ def _build_batched_engine(
         cfg, slots=4, max_len=16, buckets=BucketSpec((8, 16)),
         mesh_cfg=mesh_cfg, weight_quant=weight_quant,
         adapters=_lora_registry(cfg, lora_rank),
+        speculative_k=speculative_k,
     )
     fn = engine.program(kind)
     args = engine.example_args(kind, engine._place_params(params))
@@ -268,6 +270,7 @@ def _build_paged_engine(
     kv_quant: str = "none",
     weight_quant: str = "none",
     lora_rank: int | None = None,
+    speculative_k: int = 0,
     audit_extra: dict | None = None,
 ):
     """A paged slot-batched serving program
@@ -290,6 +293,7 @@ def _build_paged_engine(
         cfg, slots=4, max_len=16, page_size=8, pool_pages=8,
         prefill_chunk=8, kv_quant=kv_quant, weight_quant=weight_quant,
         adapters=_lora_registry(cfg, lora_rank),
+        speculative_k=speculative_k,
     )
     fn = engine.program(kind)
     args = engine.example_args(kind, engine._place_params(params))
@@ -638,6 +642,65 @@ def registered_cases() -> dict[str, AuditCase]:
                 audit_extra={
                     "q8_cast_budget": {"to_int8": 0, "from_int8": 4},
                 },
+            ),
+        ),
+        # Batched speculative-decoding programs (serving/engine.py
+        # speculative_k): the [B, k+1] verify forward with per-row
+        # TRACED accept lengths. The contract under audit: acceptance
+        # is data, not shape — drafts/accept lengths are operands and
+        # outputs, so the programs keep the donated cache strictly
+        # aliased, the single-device cases add no collectives, and the
+        # TP case keeps the pinned Megatron all-reduce count (the k+1-
+        # wide forward runs the SAME per-layer psums as the 1-wide
+        # step). vma-check runs over the TP body like every shard_map
+        # case — the accept-length chain derives from psum-replicated
+        # logits, so it types invariant (the divergent-trip-count
+        # hazard this program family could introduce is tested with a
+        # deliberately-broken twin in tests/test_analysis.py).
+        AuditCase(
+            "decode_batched_spec_step",
+            "slot-batched speculative verify step ([B, k+1] window, "
+            "traced per-row accept lengths, donated slot cache): "
+            "single device, any collective is a bug",
+            1,
+            lambda: _build_batched_engine(
+                "decode_spec_step", speculative_k=3
+            ),
+        ),
+        AuditCase(
+            "decode_paged_spec_step",
+            "paged speculative verify step (block-table k+1-token "
+            "window, tail-page rollback, donated page pool): single "
+            "device, any collective is a bug",
+            1,
+            lambda: _build_paged_engine(
+                "decode_spec_step", speculative_k=3
+            ),
+        ),
+        AuditCase(
+            "decode_batched_step_tp_spec",
+            "slot-batched speculative verify step over tensor=4: the "
+            "k+1-wide forward must keep the pinned Megatron all-reduce "
+            "count (2) — verification widens the token dim, never the "
+            "collective structure, and the traced accept lengths "
+            "derive from psum-replicated logits (vma-invariant)",
+            4,
+            lambda: _build_batched_engine(
+                "decode_spec_step",
+                mesh_cfg=MeshConfig(tensor=4, strategy="no_shard"),
+                speculative_k=3,
+                budget=CollectiveBudget(
+                    required={"all-reduce"},
+                    forbidden={
+                        "all-gather", "reduce-scatter", "all-to-all",
+                        "collective-permute",
+                    },
+                    note="speculative verification must not move the "
+                         "Megatron collective structure: accept "
+                         "lengths are elementwise functions of the "
+                         "already-reduced logits",
+                ),
+                budget_case="decode_batched_step_tp",
             ),
         ),
         # Multi-tenant LoRA serving programs (serving/adapters.py): the

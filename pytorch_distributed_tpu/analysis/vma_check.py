@@ -58,7 +58,19 @@ Reported findings (``checker="vma"``):
   cond branch / while body whose predicate varies over a: peers along a
   disagree on whether to rendezvous (deadlock, or a mismatched exchange).
   This machine-checks the uniform-collective contract the 1F1B pipeline
-  documents (parallel/pipeline.py).
+  documents (parallel/pipeline.py). The finding carries ``via`` detail
+  distinguishing the two routes in: ``cond-branch`` (devices take
+  different branches) and ``while-trip-count`` (devices run the loop a
+  different number of times). The trip-count route is how DECODE
+  SAMPLING breaks programs: a generation/verify loop advanced by a
+  sampled token or a speculative accept length (the serving engines'
+  traced-trip-count decode loops, models/speculative.py's verify loop)
+  diverges when the sampled value derives from logits that were never
+  psum-replicated — each shard then iterates a different number of
+  times and the next iteration's in-body psums deadlock. The fixpoint
+  carry propagation is what catches it: the sampled value reaches the
+  predicate only through the carry, so the divergence is invisible on
+  the first pass (pinned in tests/test_analysis.py).
 - ``redundant-collective`` (warn, rule 3) — psum/pmax/pmin over an axis
   the operand is already invariant on (literal operands are exempt: the
   ``psum(1, axis)`` axis-size idiom reduces a constant on purpose).
@@ -195,16 +207,38 @@ class VmaInterpreter:
         )
 
     def _check_divergence(self, eqn, axes, divergent, record) -> None:
+        """``divergent`` maps each divergent axis to HOW control flow
+        diverged over it: ``cond-branch`` (devices take different
+        branches) or ``while-trip-count`` (devices run the loop a
+        different number of times — the decode-sampling hazard: a
+        speculative verify loop whose accept length derives from
+        NON-reduced logits gives every shard its own trip count, and
+        the next iteration's psums deadlock). The finding names the
+        route so the fix is obvious: gate the RESULT for a branch,
+        reduce the sampled value feeding the predicate for a trip
+        count."""
         clash = set(axes) & set(divergent)
         if clash and record:
+            vias = sorted({divergent[a] for a in clash})
+            how = (
+                "a while loop whose TRIP COUNT varies over the same "
+                "axis/axes (each device iterates a different number of "
+                "times — e.g. a decode loop advanced by a sampled "
+                "accept length that was never psum-replicated)"
+                if vias == ["while-trip-count"]
+                else "control flow whose predicate varies over the "
+                     "same axis/axes"
+            )
             self._finding(
                 "divergent-collective", "error",
-                f"{eqn.primitive.name} over {sorted(clash)} executes under "
-                "control flow whose predicate varies over the same "
-                "axis/axes: peers disagree on whether to communicate "
+                f"{eqn.primitive.name} over {sorted(clash)} executes "
+                f"under {how}: peers disagree on whether to communicate "
                 "(deadlock or mismatched exchange); hoist the collective "
-                "out of the branch and gate its RESULT instead",
+                "out of the divergent region and gate its RESULT — or, "
+                "for a sampling-driven trip count, reduce the value "
+                "feeding the predicate first",
                 primitive=eqn.primitive.name, axes=sorted(clash),
+                via=vias,
             )
 
     # -- interpretation ---------------------------------------------------
@@ -223,9 +257,14 @@ class VmaInterpreter:
         in_vmas,
         *,
         record: bool = True,
-        divergent: frozenset = frozenset(),
+        divergent=(),
     ) -> list[frozenset]:
-        """vmas of ``jaxpr.outvars`` given vmas of its invars."""
+        """vmas of ``jaxpr.outvars`` given vmas of its invars.
+        ``divergent`` maps axis name -> divergence route ("cond-branch"
+        / "while-trip-count"); a bare axis iterable is accepted and
+        treated as cond-branch divergence."""
+        if not isinstance(divergent, dict):
+            divergent = {a: "cond-branch" for a in divergent}
         outs = self._run(
             jaxpr, [(frozenset(s), False) for s in in_vmas],
             record=record, divergent=divergent,
@@ -357,6 +396,16 @@ class VmaInterpreter:
         )
         return self._join_carry(carry, outs[:ncar]) + outs[ncar:]
 
+    @staticmethod
+    def _diverge(divergent: dict, axes: frozenset, via: str) -> dict:
+        """Enter a divergent region: the predicate's axes join the map
+        tagged with HOW control flow diverges over them (an axis
+        already divergent from an enclosing region keeps its original
+        route — the outermost divergence is the one to fix first)."""
+        if not axes:
+            return divergent
+        return {**{a: via for a in axes}, **divergent}
+
     def _while(self, eqn, ins, record, divergent):
         p = eqn.params
         cn, bn = p["cond_nconsts"], p["body_nconsts"]
@@ -370,7 +419,8 @@ class VmaInterpreter:
             )[0][0]
             outs = self._run(
                 loop_body, bc + carry, record=False,
-                divergent=divergent | pred,
+                divergent=self._diverge(divergent, pred,
+                                        "while-trip-count"),
             )
             # A varying predicate means devices disagree on the trip
             # count, so every carry is device-dependent afterwards.
@@ -382,21 +432,24 @@ class VmaInterpreter:
         # varying predicate devices disagree on the trip count, so a
         # collective in the COND body (re-entered a different number of
         # times per device) mismatches exactly like one in the loop body.
-        self._run(
-            cond_body, cc + carry, record=record, divergent=divergent | pred
-        )
-        self._run(
-            loop_body, bc + carry, record=record, divergent=divergent | pred
-        )
+        # The fixpoint matters for the decode-sampling case: a sampled
+        # accept length reaches the predicate only through the carry, so
+        # the divergence appears on iteration 2 — the rule covers
+        # sampling-driven trip counts, not just syntactically-varying
+        # predicates (pinned in tests/test_analysis.py).
+        trip_div = self._diverge(divergent, pred, "while-trip-count")
+        self._run(cond_body, cc + carry, record=record, divergent=trip_div)
+        self._run(loop_body, bc + carry, record=record, divergent=trip_div)
         return carry
 
     def _cond(self, eqn, ins, record, divergent):
         (pred, pred_const), ops = ins[0], ins[1:]
+        branch_div = self._diverge(divergent, pred, "cond-branch")
         branch_outs = []
         for br in eqn.params["branches"]:
             body = _sub_jaxpr(br)
             branch_outs.append(
-                self._run(body, ops, record=record, divergent=divergent | pred)
+                self._run(body, ops, record=record, divergent=branch_div)
             )
         return [
             (

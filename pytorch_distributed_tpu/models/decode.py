@@ -216,15 +216,41 @@ def _write(cache_layer, new, pos, block_tables=None):
     rows write distinct pages (the copy-on-write discipline of
     serving/block_pool.py), so the scatter has no cross-row collisions;
     free rows' tables are all-zero, colliding harmlessly on the
-    never-read scratch page."""
+    never-read scratch page.
+
+    Multi-token windows past a row's extent are SAFE, not clamped: the
+    speculative verify step (serving engines, ``speculative_k``) writes
+    T = k+1 tokens per row, and a deep row's draft lanes can index past
+    its table (paged) or past ``max_len`` (dense). XLA's default gather/
+    dynamic_update_slice clamping would silently redirect those writes
+    onto LIVE positions, so they are handled explicitly: paged lanes
+    past the table redirect to the never-read scratch page (page 0),
+    and dense per-row multi-token writes use a scatter with
+    ``mode="drop"`` so out-of-range lanes write nothing. The host only
+    ever commits tokens whose positions were in range, so dropped lanes
+    are always rejected-draft garbage."""
     new = new.astype(cache_layer.dtype)
     if block_tables is not None:
         page = cache_layer.shape[1]
         b, t = new.shape[:2]
+        n_pages = block_tables.shape[1]
         gpos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]  # [B,T]
-        pids = jnp.take_along_axis(block_tables, gpos // page, axis=1)
+        pidx = gpos // page
+        pids = jnp.take_along_axis(
+            block_tables, jnp.minimum(pidx, n_pages - 1), axis=1
+        )
+        pids = jnp.where(pidx < n_pages, pids, 0)  # OOB -> scratch page
         return cache_layer.at[pids, gpos % page].set(new)
     if getattr(pos, "ndim", 0):
+        if new.shape[1] > 1:
+            # Per-row MULTI-token write (the dense speculative verify
+            # window): scatter with mode="drop" — a lane past max_len is
+            # dropped instead of dynamic_update_slice's clamp-shift,
+            # which would slide the whole window onto committed rows.
+            b, t = new.shape[:2]
+            gpos = pos[:, None] + jnp.arange(t, dtype=jnp.int32)[None]
+            rows = jax.lax.broadcasted_iota(jnp.int32, (b, t), 0)
+            return cache_layer.at[rows, gpos].set(new, mode="drop")
         return jax.vmap(
             lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (p, 0, 0))
         )(cache_layer, new, pos)
@@ -650,6 +676,28 @@ def sample_token_rows(logits, greedy, temperature, keys, top_k, top_p):
         return jnp.where(g, _sample_greedy(l[None])[0], drawn)
 
     return jax.vmap(row)(logits, greedy, temperature, keys, top_k, top_p)
+
+
+def speculative_accept(
+    drafts: jax.Array,      # [B, K] int32 draft tokens (lane-padded)
+    verified: jax.Array,    # [B, K] int32 greedy next-tokens for lanes 0..K-1
+    n_draft: jax.Array,     # [B] int32 valid draft count per row (0..K)
+) -> jax.Array:
+    """Per-row TRACED accept lengths for batched speculative decoding
+    (serving/engine.py ``decode_spec_step``): draft lane j survives iff
+    every earlier lane survived AND it matches the model's own greedy
+    choice for that position AND the lane is valid (j < n_draft[b] —
+    rows with fewer drafts than the program width ride padded lanes
+    that can never be accepted). Returns [B] int32 in [0, K]; the
+    committed tokens are then ``out[b, :n_acc[b]+1]`` (accepted drafts
+    plus the model's bonus/correction token) — the same acceptance rule
+    as the serial prompt-lookup loop (models/speculative.py), so the
+    greedy output is the plain decode by construction, whatever the
+    drafts were. All rows share one compiled program: acceptance is
+    data, not shape."""
+    lanes = jax.lax.broadcasted_iota(jnp.int32, drafts.shape, 1)
+    match = (drafts == verified) & (lanes < n_draft[:, None])
+    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
 
 
 def _generate_impl(

@@ -1,4 +1,25 @@
-"""Prompt-lookup speculative decoding (greedy, single sequence).
+"""Prompt-lookup speculative decoding: the host-side drafter + the
+single-sequence monolithic reference loop.
+
+Since the batched-speculation PR the SERVING implementation lives in
+``serving/engine.py``: ``BatchedDecodeEngine`` /
+``PagedBatchedDecodeEngine`` built with ``speculative_k=K`` draft k
+tokens per row host-side (``prompt_lookup_draft`` below), verify every
+row's drafts in ONE batched ``decode_spec_step`` forward with per-row
+traced accept lengths (models/decode.speculative_accept), and roll back
+rejected drafts by simply not advancing the row past its accepted depth
+— on the paged engine that truncation confines speculative garbage to
+the row's private tail page. ``scripts/generate.py --speculative``
+routes through that engine path for dense configs. This module keeps:
+
+- ``prompt_lookup_draft`` — the numpy n-gram drafter the engines call
+  per row per tick (and the one place its semantics live, so the
+  host and traced lookups cannot drift);
+- ``generate_speculative`` — the original one-jit greedy loop, kept as
+  the bit-pinned REFERENCE the engine path is equivalence-tested
+  against (tests/test_speculative.py + tests/test_serving_spec.py) and
+  as the MoE fallback (the batched engines reject MoE configs: expert
+  capacity couples rows).
 
 Speculative decoding amortises the per-step HBM cost of autoregressive
 generation: batched-1 decode is bandwidth-bound (every step streams the
@@ -40,18 +61,19 @@ corrections to stay distribution-exact, which is out of scope here and
 rejected loudly. Single sequence (B=1): acceptance length varies per
 row, which would need per-row cache offsets; batch the PROMPTS instead.
 
-Why the KV cache stays jit-internal (NOT routed through the serving
-engine's donated cache): the verify loop is a ``lax.while_loop`` whose
+Why this REFERENCE loop keeps its KV cache jit-internal (the serving
+engines donate theirs): the verify loop is a ``lax.while_loop`` whose
 per-iteration forward length is K+1 and whose trip count depends on
 acceptance — the cache never crosses a program boundary, so there is
-nothing to donate ACROSS; splitting the loop into per-iteration engine
-dispatches would add one host round-trip per verify step (the latency
-speculative decoding exists to amortise) to save one cache
-allocation+zero-fill per CALL — a [L, 1, S, Hkv, D] memset amortised
-over the whole generation, measured in the noise next to a single
-verify forward. The decision is pinned where it can't rot:
-tests/test_speculative.py asserts bit-equivalence against BOTH the
-monolithic greedy reference and the serving engine's greedy output.
+nothing to donate ACROSS; splitting the loop into per-iteration
+dispatches is exactly what the engine path does, paying one host
+round-trip per verify step to buy continuous batching, the donated
+paged pool, and the fault model. Single-sequence latency-only callers
+lose nothing here; everything serving-shaped goes through the engine.
+The decision is pinned where it can't rot: tests/test_speculative.py
+asserts bit-equivalence against BOTH the monolithic greedy reference
+and the serving engine's greedy output, and tests/test_serving_spec.py
+pins the batched engine path against this loop.
 """
 
 from __future__ import annotations
@@ -60,9 +82,40 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from pytorch_distributed_tpu.config import ModelConfig
 from pytorch_distributed_tpu.models import decode
+
+
+def prompt_lookup_draft(
+    tokens: np.ndarray, k: int, ngram: int = 2
+) -> np.ndarray:
+    """Host-side prompt-lookup drafter (the HF
+    ``prompt_lookup_num_tokens`` technique): find the most recent
+    EARLIER occurrence of the trailing ``ngram`` of ``tokens`` and
+    return up to ``k`` tokens that followed it ([<=k] int32; empty when
+    no match or history is shorter than the n-gram). Shared by the
+    batched serving engines (one call per greedy row per tick — numpy,
+    zero model cost) and semantically identical to the traced
+    ``_lookup_draft`` the monolithic reference uses: windows fully
+    inside the known prefix, the trailing n-gram itself excluded, most
+    recent match wins. Drafts are proposals only — the verify forward
+    is the ground truth — so this function can never affect output
+    tokens, only speed."""
+    tokens = np.asarray(tokens, np.int32)
+    n = tokens.shape[0]
+    if k < 1 or n <= ngram:
+        return np.zeros((0,), np.int32)
+    tail = tokens[-ngram:]
+    windows = np.lib.stride_tricks.sliding_window_view(tokens, ngram)
+    # Candidate windows end strictly before the tail starts the match
+    # position: starts 0..n-ngram-1 (the final window IS the tail).
+    hits = np.nonzero(np.all(windows[:-1] == tail[None, :], axis=1))[0]
+    if hits.size == 0:
+        return np.zeros((0,), np.int32)
+    best = int(hits[-1])  # most recent match = closest context
+    return tokens[best + ngram : best + ngram + k].copy()
 
 
 def _lookup_draft(out_buf, pos, *, ngram: int, draft_len: int, total: int):

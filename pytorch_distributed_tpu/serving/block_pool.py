@@ -159,6 +159,20 @@ class BlockPool:
             if self._ref.get(pid, 0) == 0
         )
 
+    def refcount(self, pid: int) -> int:
+        """Current reference count of one page (0 = free or cache-
+        retained only). Introspection for the sharing pins: the
+        speculative-rollback tests read it to prove a shared prefix
+        page stays multiply-referenced — and byte-untouched — while a
+        borrowing row speculates past it."""
+        return self._ref.get(pid, 0)
+
+    def cached_page_ids(self) -> set[int]:
+        """Page ids currently retained by the prefix cache (a copy).
+        The COW/rollback pins snapshot these pages' device content
+        around a speculating neighbour's run."""
+        return set(self._cached_pages)
+
     def pin(self, keys) -> None:
         """Protect cached chunks from LRU eviction (unknown keys are
         ignored — a chunk can lose the first-writer race or die with a

@@ -64,7 +64,8 @@ class ChaosDroppedResult(RuntimeError):
 class Fault(_chaos.Fault):
     """One scripted serving injection. ``tick`` is the engine's step
     counter (first step = tick 1). ``program`` restricts dispatch faults
-    to 'prefill' / 'decode_step' (None = first dispatch of the tick);
+    to 'prefill' / 'decode_step' / 'decode_spec_step' (None = first
+    dispatch of the tick);
     ``row`` picks the nan_row target slot (None = seeded choice among
     active rows); ``seconds`` is the slow_tick stall."""
 
@@ -130,7 +131,7 @@ class FaultInjector(ScriptedFaults):
             raise ChaosDroppedResult(
                 f"injected result loss (tick {tick}, {kind})"
             )
-        if kind == "decode_step":
+        if kind in ("decode_step", "decode_spec_step"):
             f = self._pop("nan_row", kind)
             if f is not None:
                 row = f.row

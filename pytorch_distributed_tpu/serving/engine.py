@@ -111,7 +111,8 @@ from pytorch_distributed_tpu.serving.scheduler import (
 from pytorch_distributed_tpu.utils.logging import log_event
 
 _PROGRAM_KINDS = ("prefill", "decode_run", "decode_step")
-_BATCHED_PROGRAM_KINDS = ("prefill", "decode_step")
+_BATCHED_PROGRAM_KINDS = ("prefill", "decode_step", "decode_spec_step")
+_EMPTY_DRAFT = np.zeros((0,), np.int32)
 
 
 def _kv_bytes_per_position(cfg: ModelConfig, kv_quant: str = "none") -> int:
@@ -155,6 +156,16 @@ def _quantized_mesh_specs(cfg: ModelConfig, mesh, p_specs):
         is_leaf=lambda x: isinstance(x, P),
     )
     return q_specs, shardings
+
+
+def _spec_accept_rate(counters: dict[str, int]) -> float | None:
+    """accepted/drafted over the engine's lifetime — None until the
+    first draft (and forever on engines that never speculate), so a
+    dashboard can tell "speculation off/idle" from "0% accepts"."""
+    drafted = counters.get("drafted_tokens", 0)
+    if not drafted:
+        return None
+    return round(counters.get("accepted_tokens", 0) / drafted, 4)
 
 
 def _reject_tp_zero3_mix(mesh_cfg: MeshConfig | None, entry: str) -> None:
@@ -337,9 +348,13 @@ class DecodeEngine:
         # (lifecycle.RequestFailed) instead of returning garbage tokens.
         self._nan_guard = bool(nan_guard)
         # Monotonic request counters — the serial slice of the uniform
-        # ``stats()`` schema (see BatchedDecodeEngine.stats).
+        # ``stats()`` schema (see BatchedDecodeEngine.stats). The
+        # speculative counters are part of the uniform schema too: the
+        # serial engine never drafts, so they stay 0 — consumers read
+        # one key set whichever engine backs a replica.
         self.counters: dict[str, int] = {
             "requests": 0, "done": 0, "failed": 0, "nan_retries": 0,
+            "drafted_tokens": 0, "accepted_tokens": 0, "spec_commits": 0,
         }
 
     def stats(self) -> dict[str, Any]:
@@ -364,6 +379,8 @@ class DecodeEngine:
             "sessions": None,
             "prefix_hit_rate": None,
             "kv_quant": "none",
+            "speculative_k": 0,
+            "spec_accept_rate": _spec_accept_rate(self.counters),
             "counters": dict(self.counters),
         }
 
@@ -944,6 +961,26 @@ class BatchedDecodeEngine:
     + (buckets x prefill group sizes) prefill shapes — compile_count()
     is asserted flat across admit/retire churn in tests.
 
+    **Batched speculative decoding** (``speculative_k=K`` > 0): decode
+    is bandwidth-bound — every tick streams the whole model to emit ONE
+    token per row — so each tick instead drafts up to K tokens per
+    GREEDY row host-side (prompt-lookup n-gram match over the row's
+    tokens-so-far, ``models/speculative.prompt_lookup_draft``; or the
+    engine's ``draft_hook``) and verifies ALL rows' drafts in ONE
+    [slots, K+1] ``decode_spec_step`` forward. Accept lengths are
+    per-row TRACED outputs (``decode.speculative_accept``), so rows
+    accepting 0..K tokens share one compiled program — the decode tick
+    count drops by the mean accepted length while every contract above
+    (zero steady compiles, strict donation, rows-invariant collectives)
+    holds verbatim. Greedy speculative output is TOKEN-EQUAL to the
+    non-speculative engine by construction: the verification forward is
+    the ground truth, drafts only change speed. Sampled rows ride the
+    same program with zero drafts (their lane-0 draw bit-matches the
+    plain step; exact sampled speculation needs rejection-sampling
+    corrections — out of scope). When drafting LOSES — low-repetition
+    streams pay the (K+1)-wide verify for ~0 accepts — see
+    benchmarks/PERF_NOTES.md.
+
     Not thread-safe (single dispatcher per engine); requests are
     single-sequence (one row each — batch your own beams as separate
     requests).
@@ -973,7 +1010,7 @@ class BatchedDecodeEngine:
     """
 
     # The donated cache's positional index in each program signature.
-    CACHE_ARGNUM = {"prefill": 4, "decode_step": 2}
+    CACHE_ARGNUM = {"prefill": 4, "decode_step": 2, "decode_spec_step": 2}
 
     def __init__(
         self,
@@ -993,6 +1030,9 @@ class BatchedDecodeEngine:
         sleep=None,
         weight_quant: str = "none",
         adapters=None,
+        speculative_k: int = 0,
+        spec_ngram: int = 2,
+        draft_hook=None,
     ) -> None:
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -1035,6 +1075,41 @@ class BatchedDecodeEngine:
         self.mode, self.mesh_cfg, self._n_kv, _ = _select_mode(
             cfg, mesh_cfg, entry="BatchedDecodeEngine", allow_zero3=False
         )
+        # Per-row speculative decoding (batched prompt-lookup — ROADMAP
+        # direction 3): with speculative_k=K > 0 every decode tick
+        # drafts up to K tokens per GREEDY row host-side (zero model
+        # cost; ``draft_hook(tokens_so_far, k) -> drafts`` overrides the
+        # n-gram lookup, e.g. for a small draft model later) and ONE
+        # batched ``decode_spec_step`` forward verifies all rows'
+        # drafts with per-row TRACED accept lengths — rows accepting
+        # 0..K tokens share one compiled program, so the zero-steady-
+        # compile / strict-donation / rows-invariant-collective
+        # contracts survive unchanged. K=0 keeps the exact pre-spec
+        # programs (decode_spec_step is never built). Sampled rows ride
+        # the same program with zero drafts: distribution-exact sampled
+        # speculation needs rejection-sampling corrections, which stay
+        # out of scope (models/speculative.py).
+        if speculative_k < 0:
+            raise ValueError(
+                f"speculative_k must be >= 0, got {speculative_k} "
+                "(0 disables speculation)"
+            )
+        if speculative_k >= max_len:
+            raise ValueError(
+                f"speculative_k ({speculative_k}) must be < max_len "
+                f"({max_len}): the verify window is k+1 tokens wide and "
+                "has to fit a row's cache extent"
+            )
+        if spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
+        if draft_hook is not None and not callable(draft_hook):
+            raise ValueError(
+                "draft_hook must be callable: (tokens_so_far [n] int32, "
+                "k) -> up to k draft tokens"
+            )
+        self.speculative_k = int(speculative_k)
+        self.spec_ngram = int(spec_ngram)
+        self._draft_hook = draft_hook
         self.weight_quant = _check_quant_arg("weight_quant", weight_quant)
         # Multi-tenant LoRA (serving/adapters.py): when a registry is
         # attached, every dispatch carries TWO extra traced operands —
@@ -1117,6 +1192,12 @@ class BatchedDecodeEngine:
             "done": 0, "failed": 0, "aborted": 0, "expired": 0,
             "nan_quarantines": 0, "dispatch_failures": 0, "resumes": 0,
             "cache_allocs": 0,
+            # Speculation (monotonic; 0 forever when speculative_k=0):
+            # drafted = lanes offered to the verifier, accepted = extra
+            # tokens committed beyond the one a plain tick yields,
+            # spec_commits = row-ticks that went through the verify
+            # path (the mean-accepted-length denominator).
+            "drafted_tokens": 0, "accepted_tokens": 0, "spec_commits": 0,
         }
 
     # -- cache -------------------------------------------------------------
@@ -1198,7 +1279,53 @@ class BatchedDecodeEngine:
             tok = decode.sample_token_rows(last, greedy, t, keys, k, p)
             return tok, decode.nonfinite_rows(last), cache
 
-        return {"prefill": prefill, "decode_step": decode_step}
+        def decode_spec_step(params, toks, cache, pos, folds,
+                             greedy, t, k, p, keydata, n_draft, *lora):
+            # ``toks`` [B, K+1]: lane 0 = each row's last committed
+            # token, lanes 1..K = host drafts (lane-padded; n_draft [B]
+            # marks the valid count). ONE forward verifies every row's
+            # window; per-row accept lengths are traced, so 0..K
+            # accepts share this executable. Lane 0's sampled draw uses
+            # the row's ordinary fold schedule — a zero-draft row (and
+            # every sampled row) commits exactly the plain decode_step
+            # token.
+            return self._spec_verify(
+                self._forward(params, toks, cache, pos, lora),
+                toks, folds, greedy, t, k, p, keydata, n_draft,
+            )
+
+        return {
+            "prefill": prefill,
+            "decode_step": decode_step,
+            "decode_spec_step": decode_spec_step,
+        }
+
+    @staticmethod
+    def _spec_verify(forward_out, toks, folds, greedy, t, k, p,
+                     keydata, n_draft):
+        """Shared verification tail of both engines' spec bodies (the
+        dense/paged programs differ only in how the forward is wired):
+        sample lane 0 with the row's key/fold (bit-matching the plain
+        step), take the model's own greedy chain over the window, and
+        compute the traced accept lengths. Returns
+        (out [B, K+1], n_acc [B], bad [B], cache) — the host commits
+        ``out[b, :n_acc[b]+1]``, clipped by EOS/budget."""
+        logits, cache = forward_out  # [B, K+1, V]
+        keys = jax.vmap(jax.random.fold_in)(
+            jax.random.wrap_key_data(keydata), folds
+        )
+        tok0 = decode.sample_token_rows(
+            logits[:, 0], greedy, t, keys, k, p
+        )
+        ver = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+        n_acc = decode.speculative_accept(
+            toks[:, 1:], ver[:, :-1], n_draft
+        )
+        out = jnp.concatenate([tok0[:, None], ver[:, 1:]], axis=1)
+        # NaN anywhere in the window flags the row: any lane's logits
+        # could decide a committed token (one reduction, no collectives
+        # — the pinned budgets are untouched, like every sentinel).
+        return out, n_acc, decode.nonfinite_rows(logits), cache
 
     def _lora_dispatch_args(self, tenant_slots) -> tuple:
         """The two trailing LoRA operands for one dispatch — the
@@ -1225,12 +1352,42 @@ class BatchedDecodeEngine:
 
         return (self.adapters.partition_specs(), P())
 
+    def _check_program_kind(self, kind: str) -> None:
+        if kind not in _BATCHED_PROGRAM_KINDS:
+            raise KeyError(f"unknown batched program kind {kind!r}")
+        if kind == "decode_spec_step" and not self.speculative_k:
+            raise KeyError(
+                "decode_spec_step exists only on engines built with "
+                "speculative_k > 0 (this engine decodes one token per "
+                "row per tick)"
+            )
+        if kind == "decode_step" and self.speculative_k:
+            # Symmetric gate: a spec engine routes EVERY decode tick
+            # through decode_spec_step, so silently building the plain
+            # step here would cache an executable the engine never
+            # dispatches — and inflate compile_count() under the pinned
+            # zero-steady-compile assertions.
+            raise KeyError(
+                "this engine was built with speculative_k="
+                f"{self.speculative_k}: every decode tick dispatches "
+                "decode_spec_step — request that kind instead"
+            )
+
+    def _program_kinds(self) -> tuple[str, ...]:
+        """The program kinds THIS engine actually dispatches: a spec
+        engine's every decode tick goes through decode_spec_step (rows
+        without drafts ride zero-draft lanes), so the plain decode_step
+        is never built there — and vice versa."""
+        return (
+            "prefill",
+            "decode_spec_step" if self.speculative_k else "decode_step",
+        )
+
     def program(self, kind: str):
         """The jitted program for ``kind`` — public for the audit
         registry (analysis/registry.py) and tests, like
         ``DecodeEngine.program``."""
-        if kind not in _BATCHED_PROGRAM_KINDS:
-            raise KeyError(f"unknown batched program kind {kind!r}")
+        self._check_program_kind(kind)
         prog = self._programs.get(kind)
         if prog is not None:
             return prog
@@ -1256,12 +1413,23 @@ class BatchedDecodeEngine:
                     self._p_specs, P(), cache_spec, P(), P(),
                     P(), P(), P(), P(), P(),
                 ),
+                # decode_step + the [B] n_draft operand; outputs grow
+                # the replicated [B] accept lengths.
+                "decode_spec_step": (
+                    self._p_specs, P(), cache_spec, P(), P(),
+                    P(), P(), P(), P(), P(), P(),
+                ),
             }[kind] + self._lora_in_specs()
+            out_specs = (
+                (P(), P(), P(), cache_spec)
+                if kind == "decode_spec_step"
+                else (P(), P(), cache_spec)
+            )
             smapped = shard_map(
                 body,
                 mesh=self._mesh,
                 in_specs=specs,
-                out_specs=(P(), P(), cache_spec),
+                out_specs=out_specs,
                 check_vma=True,
             )
             prog = jax.jit(smapped, donate_argnums=donate)
@@ -1576,12 +1744,37 @@ class BatchedDecodeEngine:
                 )
                 _, _, cache = self.program("prefill")(*args)
                 self._cache = cache
+        self._rewarm_first_prefill(params)
+        step_kind = self._program_kinds()[-1]
         args = self.example_args(
-            "decode_step", params, cache=self._take_cache()
+            step_kind, params, cache=self._take_cache()
         )
-        _, _, cache = self.program("decode_step")(*args)
+        *_, cache = self.program(step_kind)(*args)
         self._cache = cache
         return self.compile_count()
+
+    def _rewarm_first_prefill(self, params) -> None:
+        """Close a meshed-warmup hole: the warmup loop's FIRST dispatch
+        keyed its executable on the freshly ``device_put`` cache's
+        sharding, but every steady-state dispatch presents the
+        donated-OUTPUT sharding instead — which can hash differently,
+        so the first shape recompiled once mid-traffic (observed on TP;
+        regression-pinned by the zero-steady-compile assertions in
+        decode_bench --serving-spec and tests). Re-dispatching that one
+        shape with the laundered cache keys the warm set exactly as
+        serving will hit it."""
+        if self.mode == "plain":
+            return
+        args = self.example_args(
+            "prefill", params,
+            bucket=(
+                self._prefill_buckets[0] if self._prefill_buckets
+                else None
+            ),
+            group=self._groups[0], cache=self._take_cache(),
+        )
+        _, _, cache = self.program("prefill")(*args)
+        self._cache = cache
 
     # -- fault injection / crash recovery ------------------------------------
 
@@ -1930,7 +2123,123 @@ class BatchedDecodeEngine:
             self._maybe_retire(row, finished)
         return True
 
+    # -- speculation (host side) -------------------------------------------
+
+    def _draft_tokens(self, s: _Slot) -> np.ndarray:
+        """Up to ``speculative_k`` draft tokens for one active row —
+        prompt-lookup over the row's tokens-so-far (or the engine's
+        ``draft_hook``), capped so every COMMITTABLE token's position
+        stays inside the row's budget and the cache extent. Sampled
+        rows draft nothing (exact sampled speculation needs rejection-
+        sampling corrections — out of scope, models/speculative.py);
+        they still ride the same program with zero-draft lanes."""
+        if not s.greedy:
+            return _EMPTY_DRAFT
+        cap = min(
+            self.speculative_k,
+            s.max_new - len(s.generated) - 1,
+            self.max_len - s.pos - 1,
+        )
+        if cap <= 0:
+            return _EMPTY_DRAFT
+        hist = self._partial_tokens(s.prompt, s.generated)
+        if self._draft_hook is not None:
+            d = np.asarray(
+                self._draft_hook(hist, cap), np.int32
+            ).reshape(-1)[:cap]
+            # Hook output is advisory: clip to the vocab so a buggy
+            # hook can cost speed (rejected drafts) but never an OOB
+            # embedding lookup.
+            return np.clip(d, 0, self.cfg.vocab_size - 1)
+        from pytorch_distributed_tpu.models.speculative import (
+            prompt_lookup_draft,
+        )
+
+        return prompt_lookup_draft(hist, cap, ngram=self.spec_ngram)
+
+    def _commit_spec(self, row: int, s: _Slot, out_row: np.ndarray,
+                     n_acc: int, n_draft: int, finished) -> None:
+        """Commit one row's verified window: accepted drafts plus the
+        model's bonus/correction token, clipped at EOS and the row's
+        budget. Rejected drafts are rolled back by simply not advancing
+        ``pos`` past the commit — their K/V garbage sits beyond the
+        row's depth, masked by the pos discipline and overwritten by
+        later writes (on the paged engine it is confined to the row's
+        private tail page)."""
+        committed = 0
+        for tok in out_row[: n_acc + 1]:
+            s.generated.append(int(tok))
+            s.pos += 1
+            s.fold += 1
+            committed += 1
+            if len(s.generated) >= s.max_new or (
+                s.eos_id is not None and int(tok) == s.eos_id
+            ):
+                break  # EOS inside the window: later lanes discarded
+        self.counters["drafted_tokens"] += n_draft
+        self.counters["accepted_tokens"] += committed - 1
+        self.counters["spec_commits"] += 1
+        if n_draft:
+            log_event(
+                "draft_accept", rid=s.rid, drafted=n_draft,
+                accepted=committed - 1, t=round(self._clock(), 6),
+            )
+        self._maybe_retire(row, finished)
+
+    def _decode_tick_spec(self, params, finished: list[int]) -> None:
+        """The speculative twin of ``_decode_tick``: every active row's
+        lane-0 token plus its host drafts go through ONE k+1-wide
+        verify forward; per-row accept lengths come back traced."""
+        b, width = self.slots, self.speculative_k + 1
+        toks = np.zeros((b, width), np.int32)
+        n_draft = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        folds = np.zeros((b,), np.int32)
+        greedy = np.ones((b,), np.bool_)
+        t = np.ones((b,), np.float32)
+        k = np.full((b,), self.cfg.vocab_size, np.int32)
+        p = np.full((b,), 2.0, np.float32)
+        keydata = np.zeros((b, self._key_words), np.uint32)
+        tenants = np.zeros((b,), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue  # free rows verify garbage the host discards
+            drafts = self._draft_tokens(s)
+            toks[i, 0] = s.generated[-1]
+            toks[i, 1 : 1 + len(drafts)] = drafts
+            n_draft[i] = len(drafts)
+            pos[i] = s.pos
+            folds[i] = s.fold
+            greedy[i] = s.greedy
+            t[i], k[i], p[i] = s.t, s.k, s.p
+            keydata[i] = s.keydata
+            tenants[i] = s.tenant_slot
+        res = self._dispatch(
+            "decode_spec_step", params, None, finished,
+            jnp.asarray(toks), None, jnp.asarray(pos),
+            jnp.asarray(folds), jnp.asarray(greedy), jnp.asarray(t),
+            jnp.asarray(k), jnp.asarray(p), jnp.asarray(keydata),
+            jnp.asarray(n_draft),
+            *self._lora_dispatch_args(tenants),
+        )
+        if res is None:
+            return
+        out, n_acc, bad = res
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            if bad[i]:
+                self._slots[i] = None
+                self._on_slot_freed(s)
+                self._quarantine_slot(s, i, finished)
+                continue
+            self._commit_spec(
+                i, s, out[i], int(n_acc[i]), int(n_draft[i]), finished
+            )
+
     def _decode_tick(self, params, finished: list[int]) -> None:
+        if self.speculative_k:
+            return self._decode_tick_spec(params, finished)
         b = self.slots
         toks = np.zeros((b,), np.int32)
         pos = np.zeros((b,), np.int32)
@@ -2037,9 +2346,16 @@ class BatchedDecodeEngine:
         try:
             if inj is not None:
                 inj.before_dispatch(kind, self._ticks)
-            tok, bad, cache = self.program(kind)(params, *args)
+            # Programs return (tokens, ..., bad, cache): the spec step
+            # carries the per-row accept lengths between tokens and the
+            # sentinel; the injector hooks see (tokens, bad) whichever
+            # program ran.
+            *outs, cache = self.program(kind)(params, *args)
             if inj is not None:
-                tok, bad = inj.after_dispatch(kind, self._ticks, tok, bad)
+                tok, bad = inj.after_dispatch(
+                    kind, self._ticks, outs[0], outs[-1]
+                )
+                outs = [tok, *outs[1:-1], bad]
         except Exception as err:
             # Exception, not BaseException: KeyboardInterrupt/SystemExit
             # must abort the serving loop, not masquerade as a transient
@@ -2050,7 +2366,7 @@ class BatchedDecodeEngine:
             return None
         self._cache = cache
         self._fail_streak = 0
-        return np.asarray(tok), np.asarray(bad)
+        return tuple(np.asarray(o) for o in outs)
 
     def _recover_dispatch_failure(self, kind, err, group_pendings,
                                   finished) -> None:
@@ -2145,14 +2461,16 @@ class BatchedDecodeEngine:
             "sessions": None,
             "prefix_hit_rate": None,
             "kv_quant": "none",
+            "speculative_k": self.speculative_k,
+            "spec_accept_rate": _spec_accept_rate(self.counters),
             "counters": dict(self.counters),
         }
 
     def compile_count(self) -> int:
         """Total compiled executables across both programs: ONE
-        decode_step + one prefill per (bucket, group) shape served. The
-        churn tests assert this stays flat across admissions and
-        retirements at a fixed slot count."""
+        decode(_spec)_step + one prefill per (bucket, group) shape
+        served. The churn tests assert this stays flat across
+        admissions and retirements at a fixed slot count."""
         return sum(p._cache_size() for p in self._programs.values())
 
     def _bytes_per_position(self) -> int:
@@ -2208,18 +2526,34 @@ class BatchedDecodeEngine:
                 jnp.full((b,), 2.0, jnp.float32),
                 jnp.zeros((b, self._key_words), jnp.uint32),
             ) + self._lora_dispatch_args(np.zeros((b,), np.int32))
+        if kind == "decode_spec_step":
+            b, width = self.slots, self.speculative_k + 1
+            return (
+                params,
+                jnp.zeros((b, width), jnp.int32),
+                cache,
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.ones((b,), jnp.bool_),
+                jnp.ones((b,), jnp.float32),
+                jnp.full((b,), self.cfg.vocab_size, jnp.int32),
+                jnp.full((b,), 2.0, jnp.float32),
+                jnp.zeros((b, self._key_words), jnp.uint32),
+                jnp.zeros((b,), jnp.int32),
+            ) + self._lora_dispatch_args(np.zeros((b,), np.int32))
         raise KeyError(f"unknown batched program kind {kind!r}")
 
     def verify_donation(self, params) -> dict[str, dict]:
-        """Prove the slot cache actually aliases in/out of both batched
-        programs (strict mode of the donation audit) — the engine-side
-        twin of ``DecodeEngine.verify_donation``. A rejected alias would
-        double-buffer the whole (slots, max_len) cache EVERY TOKEN."""
+        """Prove the slot cache actually aliases in/out of every batched
+        program this engine dispatches (strict mode of the donation
+        audit) — the engine-side twin of ``DecodeEngine.verify_donation``.
+        A rejected alias would double-buffer the whole (slots, max_len)
+        cache EVERY TOKEN."""
         from pytorch_distributed_tpu.analysis.audit import check_donation
 
         params = self._place_params(params)
         stats_all: dict[str, dict] = {}
-        for kind in _BATCHED_PROGRAM_KINDS:
+        for kind in self._program_kinds():
             args = self.example_args(kind, params)
             compiled = self.program(kind).lower(*args).compile()
             findings, stats = check_donation(
@@ -2311,9 +2645,26 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
     default = dense-equivalent ``slots * max_len/page_size + 1``),
     ``prefill_chunk`` (chunked-prefill quantum; page-multiple dividing
     ``max_len``, default = largest such <= 64).
+
+    **Speculation on pages** (``speculative_k`` — see
+    ``BatchedDecodeEngine``): rejection rollback is just truncating the
+    row's depth. The verify window writes K/V for all k+1 lanes, but
+    every write lands at positions >= the row's committed ``pos`` —
+    strictly past any shared-prefix or session-pinned page (those cover
+    positions < the row's first private chunk), so the sha1
+    chunk-chained prefix cache never sees speculative state; committed
+    lanes occupy the row's private tail pages (grown best-effort, never
+    by preemption — ``_grow_for_drafts``), rejected lanes are masked
+    garbage overwritten by later writes, and lanes past the table
+    redirect to the scratch page. With int8 pages the per-token scales
+    make rollback free: appending (and re-appending over garbage) can
+    never re-quantize a neighbouring token. Multi-token verify windows
+    use the XLA gather fallback even under ``paged_attention="kernel"``
+    (the Pallas kernel is single-query; a multi-query twin is future
+    surface).
     """
 
-    CACHE_ARGNUM = {"prefill": 5, "decode_step": 2}
+    CACHE_ARGNUM = {"prefill": 5, "decode_step": 2, "decode_spec_step": 2}
 
     def __init__(
         self,
@@ -2571,11 +2922,30 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             tok = decode.sample_token_rows(last, greedy, t, keys, k, p)
             return tok, decode.nonfinite_rows(last), cache
 
-        return {"prefill": prefill, "decode_step": decode_step}
+        def decode_spec_step(params, toks, cache, pos, tables, folds,
+                             greedy, t, k, p, keydata, n_draft, *lora):
+            # The paged verify window: k+1 tokens write through the
+            # row's block table — committable lanes land on its private
+            # tail pages (the host grew the table to cover them), lanes
+            # past the table redirect to the scratch page
+            # (decode._write), and the shared-prefix pages are
+            # untouchable by construction (all writes land at
+            # >= the row's first private position).
+            return self._spec_verify(
+                self._forward_paged(
+                    params, toks, cache, pos, tables, lora
+                ),
+                toks, folds, greedy, t, k, p, keydata, n_draft,
+            )
+
+        return {
+            "prefill": prefill,
+            "decode_step": decode_step,
+            "decode_spec_step": decode_spec_step,
+        }
 
     def program(self, kind: str):
-        if kind not in _BATCHED_PROGRAM_KINDS:
-            raise KeyError(f"unknown batched program kind {kind!r}")
+        self._check_program_kind(kind)
         prog = self._programs.get(kind)
         if prog is not None:
             return prog
@@ -2598,12 +2968,21 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                     self._p_specs, P(), cache_spec, P(), P(), P(),
                     P(), P(), P(), P(), P(),
                 ),
+                "decode_spec_step": (
+                    self._p_specs, P(), cache_spec, P(), P(), P(),
+                    P(), P(), P(), P(), P(), P(),
+                ),
             }[kind] + self._lora_in_specs()
+            out_specs = (
+                (P(), P(), P(), cache_spec)
+                if kind == "decode_spec_step"
+                else (P(), P(), cache_spec)
+            )
             smapped = shard_map(
                 body,
                 mesh=self._mesh,
                 in_specs=specs,
-                out_specs=(P(), P(), cache_spec),
+                out_specs=out_specs,
                 check_vma=True,
             )
             prog = jax.jit(smapped, donate_argnums=donate)
@@ -2960,7 +3339,103 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 s.generated.append(int(toks[j]))
                 self._maybe_retire(row, finished)
 
+    def _grow_for_drafts(self, s: _PagedSlot, n: int) -> int:
+        """Best-effort block-table growth covering the row's draft
+        window (committable positions pos..pos+n need REAL pages — an
+        accepted draft's K/V becomes the row's cache). Returns how many
+        drafts are actually covered. Never preempts a live row and
+        never breaks a session pin: drafts are an optimisation, so page
+        pressure just shrinks the window (the verify step still commits
+        its one guaranteed token on the already-covered page; lanes
+        past the shrunk window ride table-zero lanes onto the scratch
+        page). This is also why speculative width does not change the
+        router's page-pressure accounting: at most these few
+        transiently-held tail pages per row, already counted by
+        ``pages_in_use`` like any other allocation."""
+        while s.n_pages * self.page_size <= s.pos + n:
+            got = self.pool.alloc(1)
+            if got is None:
+                n = s.n_pages * self.page_size - s.pos - 1
+                break
+            s.table[s.n_pages] = got[0]
+            s.pids += got
+            s.n_pages += 1
+        return max(0, n)
+
+    def _decode_tick_spec(self, params, finished: list[int]) -> None:
+        """The paged speculative tick: the dense ``_decode_tick_spec``
+        plus block tables, the tier-yield schedule, and draft-window
+        page growth. Rollback is depth truncation: a rejected draft's
+        K/V stays as garbage past the row's committed ``pos`` on the
+        row's PRIVATE tail page — the prefix cache and any session-
+        pinned pages never see speculative state."""
+        interactive_live = any(
+            s is not None and s.tier == TIER_RANK[INTERACTIVE]
+            for s in self._slots
+        )
+        self._ensure_decode_pages(finished, skip_batch=interactive_live)
+        ready = []
+        yielded = False
+        for i, s in enumerate(self._slots):
+            if s is None or not s.ready:
+                continue
+            if interactive_live and s.tier == TIER_RANK[BATCH]:
+                yielded = True
+                continue
+            ready.append((i, s))
+        if yielded:
+            self.counters["batch_yield_ticks"] += 1
+        if not ready:
+            return
+        b, width = self.slots, self.speculative_k + 1
+        toks = np.zeros((b, width), np.int32)
+        n_draft = np.zeros((b,), np.int32)
+        pos = np.zeros((b,), np.int32)
+        tables = np.zeros((b, self.max_pages), np.int32)
+        folds = np.zeros((b,), np.int32)
+        greedy = np.ones((b,), np.bool_)
+        t = np.ones((b,), np.float32)
+        k = np.full((b,), self.cfg.vocab_size, np.int32)
+        p = np.full((b,), 2.0, np.float32)
+        keydata = np.zeros((b, self._key_words), np.uint32)
+        tenants = np.zeros((b,), np.int32)
+        for i, s in ready:
+            drafts = self._draft_tokens(s)
+            drafts = drafts[: self._grow_for_drafts(s, len(drafts))]
+            toks[i, 0] = s.generated[-1]
+            toks[i, 1 : 1 + len(drafts)] = drafts
+            n_draft[i] = len(drafts)
+            pos[i] = s.pos
+            tables[i] = s.table
+            folds[i] = s.fold
+            greedy[i] = s.greedy
+            t[i], k[i], p[i] = s.t, s.k, s.p
+            keydata[i] = s.keydata
+            tenants[i] = s.tenant_slot
+        res = self._dispatch(
+            "decode_spec_step", params, None, finished,
+            jnp.asarray(toks), None, jnp.asarray(pos),
+            jnp.asarray(tables), jnp.asarray(folds),
+            jnp.asarray(greedy), jnp.asarray(t), jnp.asarray(k),
+            jnp.asarray(p), jnp.asarray(keydata), jnp.asarray(n_draft),
+            *self._lora_dispatch_args(tenants),
+        )
+        if res is None:
+            return
+        out, n_acc, bad = res
+        for i, s in ready:
+            if bad[i]:
+                self._slots[i] = None
+                self._on_slot_freed(s)
+                self._quarantine_slot(s, i, finished)
+                continue
+            self._commit_spec(
+                i, s, out[i], int(n_acc[i]), int(n_draft[i]), finished
+            )
+
     def _decode_tick(self, params, finished: list[int]) -> None:
+        if self.speculative_k:
+            return self._decode_tick_spec(params, finished)
         # BATCH decode yields to a live interactive row (the decode
         # half of the chunk-prefill yield below): while a latency-tier
         # request occupies a slot, throughput rows sit out the tick —
@@ -3165,10 +3640,12 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             )
             _, _, cache = self.program("prefill")(*args)
             self._cache = cache
+        self._rewarm_first_prefill(params)
+        step_kind = self._program_kinds()[-1]
         args = self.example_args(
-            "decode_step", params, cache=self._take_cache()
+            step_kind, params, cache=self._take_cache()
         )
-        _, _, cache = self.program("decode_step")(*args)
+        *_, cache = self.program(step_kind)(*args)
         self._cache = cache
         return self.compile_count()
 
@@ -3209,6 +3686,22 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 jnp.full((b,), self.cfg.vocab_size, jnp.int32),
                 jnp.full((b,), 2.0, jnp.float32),
                 jnp.zeros((b, self._key_words), jnp.uint32),
+            ) + self._lora_dispatch_args(np.zeros((b,), np.int32))
+        if kind == "decode_spec_step":
+            b, width = self.slots, self.speculative_k + 1
+            return (
+                params,
+                jnp.zeros((b, width), jnp.int32),
+                cache,
+                jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, mp), jnp.int32),
+                jnp.zeros((b,), jnp.int32),
+                jnp.ones((b,), jnp.bool_),
+                jnp.ones((b,), jnp.float32),
+                jnp.full((b,), self.cfg.vocab_size, jnp.int32),
+                jnp.full((b,), 2.0, jnp.float32),
+                jnp.zeros((b, self._key_words), jnp.uint32),
+                jnp.zeros((b,), jnp.int32),
             ) + self._lora_dispatch_args(np.zeros((b,), np.int32))
         raise KeyError(f"unknown batched program kind {kind!r}")
 

@@ -242,6 +242,14 @@ class ReplicaRouter:
             # idle, so a session-heavy replica must look loaded before
             # it starts preempting for its pinned residents
             # (regression-pinned in tests/test_serving_scenarios.py).
+            # Speculative width (engine speculative_k) deliberately
+            # does NOT enter this accounting: a speculating row's draft
+            # window lives on its own already-counted private tail
+            # pages (grown best-effort, never by preemption —
+            # engine._grow_for_drafts), so pages_in_use is the truth
+            # for spec and non-spec replicas alike; scoring a spec
+            # replica as (k+1)x wider would starve-exclude the FASTER
+            # replica.
             pinned = st.get("session_pinned_pages") or 0
             page_pressure = (
                 st["pages_in_use"] + pinned

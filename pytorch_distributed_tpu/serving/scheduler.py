@@ -38,6 +38,20 @@ Three classes, ranked (lower rank = higher priority):
   burst — sustained interactive saturation SHOULD starve batch, that
   is the tier's meaning).
 
+Accepted-token accounting under speculation (``speculative_k`` > 0):
+every tick commits 1 + accept tokens per row, so tick counts and token
+counts diverge — tier math is in TOKENS where it concerns budgets and
+deadlines (a row retires after ``max_new`` COMMITTED tokens; deadlines
+are wall-clock and care nothing for width) and in TICKS where it
+concerns the yield schedule: the bound above tightens to
+``ceil(max_new / (1 + mean accepted))`` ticks per interactive burst,
+because the latency row itself speculates through the ticks batch sits
+out. Yielded batch rows are excluded from drafting entirely (no draft
+is computed for a lane that will not dispatch), so yielding under
+speculation still recomputes nothing and batch tokens stay bit-equal —
+the engines' ``draft_accept`` log events carry the per-commit
+drafted/accepted counts the bench aggregates.
+
 Preemption generalizes PR-8's preempt-youngest to
 **preempt-lowest-priority-then-youngest**: the victim is the active row
 with the MAXIMUM ``(tier_rank, rid)`` — a batch row is preempted before

@@ -100,6 +100,40 @@ def request_stream(
     return reqs
 
 
+def repetitive_request_stream(
+    rng: np.random.Generator,
+    *,
+    n: int,
+    vocab_size: int,
+    pattern_len: tuple[int, int] = (2, 5),
+    repeats: tuple[int, int] = (3, 6),
+    max_new: int | tuple[int, int] = 16,
+) -> list[dict]:
+    """Seeded SELF-REPETITIVE greedy traffic — the stream speculative
+    decoding exists for (code, extraction, quote-heavy summarisation):
+    each prompt is a per-request random pattern tiled ``repeats``
+    times, so the prompt-lookup n-gram match fires from the first
+    generated token, and greedy decode of a fixed model self-loops
+    shortly after, keeping it firing. All rows are greedy by
+    construction (the engines draft only greedy rows); the LOW-
+    repetition counterpart is an ordinary sampled ``request_stream``
+    (sampled rows ride zero-draft lanes and pay the verify width for
+    nothing — the regression bound the spec bench documents)."""
+    lo, hi = pattern_len
+    reqs: list[dict] = []
+    for _ in range(n):
+        pat = rng.integers(
+            0, vocab_size, (int(rng.integers(lo, hi + 1)),)
+        ).astype(np.int32)
+        prompt = np.tile(pat, int(rng.integers(repeats[0], repeats[1] + 1)))
+        mn = (
+            int(max_new) if isinstance(max_new, int)
+            else int(rng.integers(max_new[0], max_new[1] + 1))
+        )
+        reqs.append(dict(prompt=prompt, max_new_tokens=mn))
+    return reqs
+
+
 def tiered_stream(
     seed: int,
     *,

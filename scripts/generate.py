@@ -265,14 +265,43 @@ def main() -> int:
             args.max_new_tokens, **sample_kw,
         )
     elif args.speculative:
-        from pytorch_distributed_tpu.models.speculative import (
-            generate_speculative,
-        )
+        if cfg.n_experts:
+            # The batched engines reject MoE (expert capacity couples
+            # rows); the monolithic reference loop stays the MoE path.
+            from pytorch_distributed_tpu.models.speculative import (
+                generate_speculative,
+            )
 
-        out = generate_speculative(
-            params, jax.numpy.asarray(ids), cfg, args.max_new_tokens,
-            draft_len=args.speculative, ngram=args.ngram,
-        )
+            out = generate_speculative(
+                params, jax.numpy.asarray(ids), cfg, args.max_new_tokens,
+                draft_len=args.speculative, ngram=args.ngram,
+            )
+        else:
+            # The serving implementation (serving/engine.py): a one-slot
+            # batched engine with per-row speculation — the same
+            # decode_spec_step programs production serving dispatches,
+            # token-equal to the monolithic reference (pinned in
+            # tests/test_serving_spec.py). The jit-internal-cache loop
+            # in models/speculative.py is retired to reference duty.
+            from pytorch_distributed_tpu.serving.engine import (
+                BatchedDecodeEngine,
+            )
+
+            engine = BatchedDecodeEngine(
+                cfg,
+                slots=1,
+                max_len=ids.shape[1] + args.max_new_tokens,
+                speculative_k=args.speculative,
+                spec_ngram=args.ngram,
+            )
+            rid = engine.submit(ids[0], args.max_new_tokens)
+            res = engine.run(params)[rid]
+            if res.state != "DONE":
+                raise SystemExit(
+                    f"speculative generation ended {res.state}: "
+                    f"{res.reason}"
+                )
+            out = np.asarray(res.tokens)[None, :]
     else:
         out = decode.generate(
             params, jax.numpy.asarray(ids), cfg, args.max_new_tokens,

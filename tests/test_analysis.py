@@ -620,6 +620,78 @@ def test_vma_catches_collective_in_divergent_while_cond(eight_devices):
     assert "divergent-collective" in [f.code for f in report.errors]
 
 
+def _spec_verify_loop(reduce_logits: bool):
+    """A miniature TP speculative-verify loop — the decode-sampling
+    trip-count shape (ROADMAP vma follow-up (b)): each iteration runs a
+    'model forward' whose row-parallel matmul partial is psum'd over
+    the tensor axis (the Megatron reduction the serving decode step
+    emits), derives an ACCEPT LENGTH from the logits' argmax chain, and
+    advances the position carry by accept+1 — the while predicate's
+    divergence therefore arrives only THROUGH the carry. With
+    ``reduce_logits=False`` the accept length reads the pre-psum
+    partials, so each shard iterates its own number of times and the
+    next iteration's psum deadlocks on real hardware."""
+
+    def f(w, x):
+        def cond(c):
+            pos, acc = c
+            return pos < 8
+
+        def body(c):
+            pos, acc = c
+            partial = (acc * x) @ w  # row-parallel: shard-local partial
+            logits = jax.lax.psum(partial, "tensor")
+            basis = logits if reduce_logits else partial
+            n_acc = jnp.argmax(basis).astype(jnp.int32) % 2
+            return pos + n_acc + 1, logits.sum()
+
+        pos, acc = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), jnp.float32(1.0))
+        )
+        return jax.lax.pmean(acc + pos, "tensor")
+
+    return f
+
+
+def test_vma_clean_on_sampling_driven_trip_count_when_reduced(
+    eight_devices,
+):
+    """The CORRECT speculative-verify shape: accept lengths derive from
+    psum-replicated logits, so every shard agrees on the trip count and
+    the in-loop psum is uniform — vma-check must pass it clean (this is
+    the shape the registry's decode_batched_step_tp_spec program relies
+    on)."""
+    mesh = Mesh(np.array(eight_devices[:4]), axis_names=("tensor",))
+    report = _vma_report(
+        _spec_verify_loop(reduce_logits=True), mesh,
+        (P(None, "tensor"), P()), P(),
+        (jnp.ones((4, 8)), jnp.ones(4)), "vma-spec-loop-clean",
+    )
+    assert report.clean(allow_warnings=True), report.table()
+
+
+def test_vma_catches_sampling_driven_divergent_trip_count(eight_devices):
+    """The BROKEN twin: the accept length reads the PRE-psum partial,
+    so the sampled value varies over the tensor axis, the carry fixpoint
+    propagates it into the while predicate, and the in-loop psum must be
+    flagged divergent-collective — with ``via`` naming the while-trip-
+    count route (not a cond branch), since the right fix is reducing
+    the value that feeds the predicate, not gating a result."""
+    mesh = Mesh(np.array(eight_devices[:4]), axis_names=("tensor",))
+    report = _vma_report(
+        _spec_verify_loop(reduce_logits=False), mesh,
+        (P(None, "tensor"), P()), P(),
+        (jnp.ones((4, 8)), jnp.ones(4)), "vma-spec-loop-divergent",
+    )
+    divergent = [
+        f for f in report.errors if f.code == "divergent-collective"
+    ]
+    assert divergent, report.table()
+    assert any(
+        "while-trip-count" in f.detail.get("via", ()) for f in divergent
+    ), [f.detail for f in divergent]
+
+
 def test_vma_allow_downgrades_named_findings(eight_devices):
     """The audit-level allow mechanism: a reasoned vma_allow turns the
     named finding into info (visible, not failing) — the analogue of a

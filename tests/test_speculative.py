@@ -1,9 +1,13 @@
-"""Prompt-lookup speculative decoding (models/speculative.py).
+"""Prompt-lookup speculative decoding — the monolithic REFERENCE loop
+(models/speculative.py; the serving implementation is the batched
+engines' ``speculative_k`` path, pinned in tests/test_serving_spec.py).
 
 The load-bearing invariant: the speculative greedy output is BITWISE the
 plain greedy decode — draft quality changes speed only. Pinned on random
 prompts (drafts mostly rejected), repetitive prompts (drafts accepted),
 MoE configs, and across draft_len/ngram settings, for both families.
+Plus the host drafter the engines call (``prompt_lookup_draft``): it
+must agree with the traced lookup's semantics.
 """
 
 import jax
@@ -117,6 +121,49 @@ def test_speculative_rejects_bad_args():
     # max_new_tokens=0: the prompt is the output.
     out = generate_speculative(params, prompt, cfg, 0)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+
+
+def test_prompt_lookup_draft_agrees_with_traced_lookup():
+    """The host drafter (what the engines call per row per tick) and
+    the traced ``_lookup_draft`` (what the reference loop compiles)
+    implement ONE semantics: most recent earlier occurrence, windows
+    fully inside the known prefix, the trailing n-gram itself excluded.
+    Checked over a seeded battery of histories; the host side returns
+    a short/empty draft exactly where the traced side zero-fills."""
+    import jax.numpy as jnp
+
+    from pytorch_distributed_tpu.models.speculative import (
+        _lookup_draft,
+        prompt_lookup_draft,
+    )
+
+    rng = np.random.default_rng(7)
+    for trial in range(40):
+        n = int(rng.integers(2, 24))
+        ngram = int(rng.integers(1, 4))
+        k = int(rng.integers(1, 6))
+        toks = rng.integers(0, 5, (n,)).astype(np.int32)  # tiny vocab
+        host = prompt_lookup_draft(toks, k, ngram=ngram)
+        total = n + k  # buffer with room for k lanes past the history
+        buf = np.zeros((1, total), np.int32)
+        buf[0, :n] = toks
+        traced = np.asarray(_lookup_draft(
+            jnp.asarray(buf), jnp.asarray(n, jnp.int32),
+            ngram=ngram, draft_len=k, total=total,
+        ))
+        # The traced lookup zero-fills unknown/beyond-history lanes;
+        # the host returns only the known continuation — the known
+        # prefix must match exactly.
+        assert len(host) <= k
+        np.testing.assert_array_equal(
+            traced[: len(host)], host,
+            err_msg=f"trial {trial}: n={n} ngram={ngram} k={k} "
+                    f"toks={toks.tolist()}",
+        )
+        if len(host) < k:
+            assert not np.any(traced[len(host):]), (
+                f"trial {trial}: traced drafted unknown lanes"
+            )
 
 
 # -- CLI contract: scripts/generate.py --speculative is greedy-only ---------
