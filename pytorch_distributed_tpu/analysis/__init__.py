@@ -2,9 +2,11 @@
 
 Audits any jitted step function's jaxpr + optimized HLO without running
 it: collective budgets per parallelism strategy, donation/aliasing,
-dtype leaks, recompilation/host-sync hazards, and the vma
+dtype leaks, recompilation/host-sync hazards, the vma
 replication/varying-axes checker for shard_map bodies (our own
-``check_vma``, independent of the jax version). See docs/ANALYSIS.md.
+``check_vma``, independent of the jax version), and a static peak-HBM
+liveness estimate diffed against pinned per-program byte budgets
+(analysis/memory.py + MemoryBudget). See docs/ANALYSIS.md.
 
 Entry points:
 - ``audit_program(fn, args, budget) -> AuditReport`` — library API;
@@ -22,15 +24,25 @@ from pytorch_distributed_tpu.analysis.audit import (
 )
 from pytorch_distributed_tpu.analysis.budget import (
     NO_COLLECTIVES,
+    STABLE_MEMORY_BUDGETS,
     CollectiveBudget,
+    MemoryBudget,
     check_budget,
+    check_memory,
     expected_budget,
+    memory_budget_for,
 )
 from pytorch_distributed_tpu.analysis.hlo import (
     HLO_COLLECTIVES,
     collective_counts,
     collective_instructions,
     parse_input_output_aliases,
+)
+from pytorch_distributed_tpu.analysis.memory import (
+    MemoryEstimate,
+    estimate_memory,
+    parse_module,
+    shape_bytes,
 )
 from pytorch_distributed_tpu.analysis.report import (
     AuditReport,
@@ -49,19 +61,27 @@ __all__ = [
     "CollectiveBudget",
     "Finding",
     "HLO_COLLECTIVES",
+    "MemoryBudget",
+    "MemoryEstimate",
     "NO_COLLECTIVES",
+    "STABLE_MEMORY_BUDGETS",
     "VmaInterpreter",
     "audit_program",
     "check_budget",
     "check_donation",
     "check_dtype",
     "check_hazards",
+    "check_memory",
     "check_shard_map_eqn",
     "check_vma_program",
     "collective_counts",
     "collective_instructions",
+    "estimate_memory",
     "expected_budget",
     "find_shard_map_eqns",
+    "memory_budget_for",
     "parse_input_output_aliases",
+    "parse_module",
     "reports_to_json",
+    "shape_bytes",
 ]

@@ -20,7 +20,15 @@ lowers + compiles it WITHOUT running it, and checks:
    varies over and diffs the result against the out_specs: missing psums,
    out_spec races, redundant collectives, collectives under divergent
    control flow (analysis/vma_check.py). Our own replication checker,
-   independent of whether the rig's jax ships ``check_vma``.
+   independent of whether the rig's jax ships ``check_vma``;
+6. memory — a static peak-HBM estimate over the optimized HLO
+   (analysis/memory.py: buffer sizes from instruction shapes, liveness
+   from a linear scan, input_output_alias honored) diffed against the
+   program's pinned ``MemoryBudget`` — and, with teeth beyond the
+   donation check's intent-verification: every donated entry parameter
+   that XLA did NOT alias is named (number, HLO name, shape, bytes), so
+   a broken in-place cache contract fails as an error pointing at the
+   exact buffer that got double-buffered.
 
 The checkers are pure functions over the lowered artifacts, so everything
 runs on the CPU test rig (``JAX_PLATFORMS=cpu`` + virtual devices) against
@@ -33,8 +41,10 @@ import jax
 
 from pytorch_distributed_tpu.analysis.budget import (
     CollectiveBudget,
+    MemoryBudget,
     check_async_overlap,
     check_budget,
+    check_memory,
 )
 from pytorch_distributed_tpu.analysis.hlo import (
     aliased_param_numbers,
@@ -46,11 +56,29 @@ from pytorch_distributed_tpu.analysis.report import AuditReport, Finding
 from pytorch_distributed_tpu.analysis.vma_check import check_vma_program
 from pytorch_distributed_tpu.profiling.trace_analysis import classify_op
 
-ALL_CHECKS = ("collectives", "donation", "dtype", "hazards", "vma")
+ALL_CHECKS = ("collectives", "donation", "dtype", "hazards", "vma", "memory")
 
 
 def _leaf_count(tree) -> int:
     return len(jax.tree.leaves(tree))
+
+
+def donated_param_numbers(
+    args: tuple, donate_argnums: tuple[int, ...]
+) -> frozenset[int]:
+    """Entry-parameter numbers the donated positional arguments flatten
+    into. jit flattens arguments in order, so argument ``i``'s leaves
+    occupy a contiguous run of parameter numbers — the same mapping
+    check_donation diffs against the alias header and check_memory uses
+    to name un-aliased donated buffers."""
+    expected: set[int] = set()
+    offset = 0
+    for i, arg in enumerate(args):
+        n = _leaf_count(arg)
+        if i in donate_argnums:
+            expected |= set(range(offset, offset + n))
+        offset += n
+    return frozenset(expected)
 
 
 def _program_jaxpr(jitted, args):
@@ -96,13 +124,7 @@ def check_donation(
     tensor in the program and must fail the audit.
     """
     aliased = aliased_param_numbers(hlo_text)
-    expected: set[int] = set()
-    offset = 0
-    for i, arg in enumerate(args):
-        n = _leaf_count(arg)
-        if i in donate_argnums:
-            expected |= set(range(offset, offset + n))
-        offset += n
+    expected = set(donated_param_numbers(args, donate_argnums))
 
     stats = {
         "expected": len(expected),
@@ -357,6 +379,8 @@ def audit_program(
     q8_cast_budget: dict[str, int] | None = None,
     checks: tuple[str, ...] = ALL_CHECKS,
     vma_allow: dict[str, str] | None = None,
+    dtype_allow: dict[str, str] | None = None,
+    memory_budget: MemoryBudget | None = None,
 ) -> AuditReport:
     """Audit a jitted program's jaxpr + optimized HLO without running it.
 
@@ -378,6 +402,13 @@ def audit_program(
     ``vma_allow``: {finding code: reason} — downgrade the named vma
     findings to info with the reason attached (the audit-level analogue of
     a repolint allow-comment: the decision stays visible in the report).
+    ``dtype_allow``: same mechanism for dtype findings — an adjudicated
+    convert chain (e.g. a deliberate f32 master-weight accumulate in a
+    bf16 program) stays in the report as info with its reason, instead of
+    tripping the ``--strict`` lane forever.
+    ``memory_budget``: the program's pinned byte ceilings
+    (budget.MemoryBudget / STABLE_MEMORY_BUDGETS); None still records the
+    static estimate in summary["memory"] without judging it.
     """
     unknown = set(checks) - set(ALL_CHECKS)
     if unknown:
@@ -393,8 +424,10 @@ def audit_program(
     # The HLO-level checks need a full XLA compile; the jaxpr-level ones
     # (dtype/hazards/vma) only need a trace — so e.g.
     # ``scripts/audit.py --only vma`` runs compile-free.
-    need_hlo = "collectives" in checks or (
-        "donation" in checks and expect_donation
+    need_hlo = (
+        "collectives" in checks
+        or "memory" in checks
+        or ("donation" in checks and expect_donation)
     )
     if need_hlo:
         compiled = jitted.lower(*args).compile()
@@ -439,6 +472,47 @@ def audit_program(
         )
         report.extend(findings)
         report.summary["donation"] = stats
+
+    if "memory" in checks:
+        from pytorch_distributed_tpu.analysis.memory import estimate_memory
+
+        try:
+            estimate = estimate_memory(hlo_text)
+        except Exception as e:
+            # An error, not a warn: a crashed estimator means the
+            # program's byte ceilings are UNVERIFIED, and the memory CI
+            # gate must not report it green.
+            report.findings.append(
+                Finding(
+                    checker="memory",
+                    code="memory-estimate-failed",
+                    severity="error",
+                    message=(
+                        f"static memory estimator crashed on this "
+                        f"program ({e!r}) — its byte budgets are "
+                        "UNVERIFIED"
+                    ),
+                )
+            )
+        else:
+            donated = (
+                donated_param_numbers(args, donate_argnums)
+                if expect_donation
+                else frozenset()
+            )
+            # No pinned budget still enforces the DEFAULT contract
+            # (MemoryBudget(): no live ceiling, zero unaliased donated
+            # bytes) — a donated input XLA failed to alias is an error
+            # naming the parameter even on unpinned programs; only a
+            # budget with an explicit allowance relaxes it.
+            mem_findings, mem_stats = check_memory(
+                estimate,
+                memory_budget if memory_budget is not None
+                else MemoryBudget(),
+                donated_params=donated,
+            )
+            report.extend(mem_findings)
+            report.summary["memory"] = mem_stats
 
     jaxpr = None
     summary = None
@@ -530,13 +604,17 @@ def audit_program(
             ),
         }
         if "dtype" in checks and compute_dtype is not None:
-            report.extend(
-                check_dtype(
-                    summary,
-                    compute_dtype,
-                    allowed_f32_dots=allowed_f32_dots,
-                )
-            )
+            allow = dtype_allow or {}
+            for f in check_dtype(
+                summary, compute_dtype, allowed_f32_dots=allowed_f32_dots
+            ):
+                if f.code in allow:
+                    f = Finding(
+                        checker=f.checker, code=f.code, severity="info",
+                        message=f"{f.message} [allowed: {allow[f.code]}]",
+                        detail=f.detail,
+                    )
+                report.findings.append(f)
         if "dtype" in checks and q8_cast_budget is not None:
             q8_findings, q8_counts = check_q8_casts(
                 summary, q8_cast_budget

@@ -1,4 +1,4 @@
-"""Collective budgets: what a parallelism strategy is ALLOWED to emit.
+"""Collective and memory budgets: what a program is ALLOWED to emit/hold.
 
 Generalises the hard-coded per-strategy assertions of
 tests/test_hlo_collectives.py into a reusable contract object:
@@ -15,6 +15,13 @@ tests/test_hlo_collectives.py into a reusable contract object:
 
 ``expected_budget`` derives the contract for a MeshConfig the same way the
 strategies themselves are written (parallel/explicit.py, parallel/pipeline.py).
+
+``MemoryBudget`` is the peer contract for bytes (analysis/memory.py's
+static peak-HBM estimate): pinned ``max_live_bytes`` ceilings per
+registered program, a hard cap on the bytes a donated input may fail to
+alias (``check_memory`` names the parameter when XLA rejects a donation),
+and an optional ceiling on the donated buffer itself (the int8-pool
+contract — an upcast to f32 triples the pool and must fail the audit).
 """
 
 from __future__ import annotations
@@ -125,6 +132,293 @@ def pin_max_counts(budget: CollectiveBudget, case: str) -> CollectiveBudget:
         max_counts={**budget.max_counts, **counts},
         note=f"{budget.note}; max_counts pinned ({case})".strip("; "),
     )
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryBudget:
+    """Byte ceilings for one compiled program's static memory estimate.
+
+    ``max_live_bytes``: ceiling on the liveness-scan peak
+    (memory.MemoryEstimate.peak_live_bytes). Pinned per registered case in
+    ``STABLE_MEMORY_BUDGETS`` the way STABLE_MAX_COUNTS pins collective
+    counts: measured once on the tiny registry models and frozen, so a
+    regression that doubles a live buffer blows the ceiling.
+    ``max_unaliased_donated_bytes``: how many bytes of DONATED input XLA
+    may fail to alias before the audit errors. 0 for the serving engines
+    (in-place cache reuse IS the contract); a measured allowance for
+    training cases that tolerate the odd reshaped optimizer slot.
+    ``max_donated_bytes``: optional ceiling on the donated argument's own
+    size — the quantized-pool contract (an int8 page pool silently upcast
+    to f32 is ~4x these bytes and must fail loudly, independent of what
+    the rest of the program does).
+    """
+
+    max_live_bytes: int | None = None
+    max_unaliased_donated_bytes: int = 0
+    max_donated_bytes: int | None = None
+    note: str = ""
+
+
+def check_memory(
+    estimate,
+    budget: MemoryBudget | None,
+    *,
+    donated_params: frozenset = frozenset(),
+) -> tuple[list[Finding], dict]:
+    """Diff a program's static memory estimate against its byte budget.
+
+    ``estimate``: analysis/memory.estimate_memory over the compiled
+    module text. ``donated_params``: the entry-parameter numbers the call
+    site donated (audit.donated_param_numbers) — every one of them should
+    appear in the accepted input_output_alias map; one that does not is
+    double-buffered at runtime, and the finding NAMES it (parameter
+    number, HLO name, shape, bytes) so the shape/dtype change that broke
+    the alias is findable. Returns (findings, stats); a None budget
+    records stats without judging them.
+    """
+    unaliased = sorted(donated_params - estimate.aliased_params)
+    unaliased_bytes = estimate.param_bytes(unaliased)
+    donated_bytes = estimate.param_bytes(donated_params)
+    loop_peaks = {
+        name: est.peak_live_bytes
+        for name, est in estimate.loop_bodies().items()
+    }
+    stats = {
+        "peak_live_bytes": estimate.peak_live_bytes,
+        "raw_peak_bytes": estimate.raw_peak_bytes,
+        "alias_saved_bytes": estimate.alias_saved_bytes,
+        "parameter_bytes": estimate.parameter_bytes,
+        "donated_bytes": donated_bytes,
+        "unaliased_donated_bytes": unaliased_bytes,
+        "unaliased_donated_params": unaliased[:16],
+        "loop_body_peak_bytes": (
+            max(loop_peaks.values()) if loop_peaks else 0
+        ),
+    }
+    findings: list[Finding] = []
+    if budget is None:
+        return findings, stats
+    stats["budget"] = {
+        "max_live_bytes": budget.max_live_bytes,
+        "max_unaliased_donated_bytes": budget.max_unaliased_donated_bytes,
+        "max_donated_bytes": budget.max_donated_bytes,
+        "note": budget.note,
+    }
+
+    if (
+        budget.max_live_bytes is not None
+        and estimate.peak_live_bytes > budget.max_live_bytes
+    ):
+        findings.append(
+            Finding(
+                checker="memory",
+                code="memory-budget-exceeded",
+                severity="error",
+                message=(
+                    f"static peak {estimate.peak_live_bytes:,} bytes > "
+                    f"pinned ceiling {budget.max_live_bytes:,} — a live "
+                    "buffer grew (lost alias, upcast, or a new "
+                    "materialisation); re-pin only if the growth is a "
+                    "deliberate contract change"
+                ),
+                detail={
+                    "peak_live_bytes": estimate.peak_live_bytes,
+                    "max_live_bytes": budget.max_live_bytes,
+                },
+            )
+        )
+    if unaliased_bytes > budget.max_unaliased_donated_bytes:
+        for pn in unaliased:
+            p = estimate.parameters.get(pn)
+            findings.append(
+                Finding(
+                    checker="memory",
+                    code="donated-param-not-aliased",
+                    severity="error",
+                    message=(
+                        f"donated parameter {pn}"
+                        + (
+                            f" (%{p.name}: {p.shape}, {p.bytes:,} bytes)"
+                            if p is not None else ""
+                        )
+                        + " has NO accepted output alias — XLA rejected "
+                        "the donation, so those bytes are double-buffered "
+                        "every call; find the shape/dtype change between "
+                        "this input and the output meant to reuse it"
+                    ),
+                    detail={
+                        "param_number": pn,
+                        "param_name": p.name if p else None,
+                        "shape": p.shape if p else None,
+                        "bytes": p.bytes if p else None,
+                        "allowance": budget.max_unaliased_donated_bytes,
+                    },
+                )
+            )
+    elif unaliased:
+        findings.append(
+            Finding(
+                checker="memory",
+                code="unaliased-donated-within-allowance",
+                severity="info",
+                message=(
+                    f"{len(unaliased)} donated parameter(s) "
+                    f"({unaliased_bytes:,} bytes) not aliased, within the "
+                    f"budget's {budget.max_unaliased_donated_bytes:,}-byte "
+                    "allowance"
+                ),
+                detail={"params": unaliased[:16],
+                        "bytes": unaliased_bytes},
+            )
+        )
+    if (
+        budget.max_donated_bytes is not None
+        and donated_bytes > budget.max_donated_bytes
+    ):
+        findings.append(
+            Finding(
+                checker="memory",
+                code="donated-bytes-exceeded",
+                severity="error",
+                message=(
+                    f"donated argument is {donated_bytes:,} bytes > "
+                    f"pinned ceiling {budget.max_donated_bytes:,} — the "
+                    "donated buffer itself grew (e.g. an int8 pool "
+                    "silently upcast to full precision)"
+                ),
+                detail={
+                    "donated_bytes": donated_bytes,
+                    "max_donated_bytes": budget.max_donated_bytes,
+                },
+            )
+        )
+    return findings, stats
+
+
+# Pinned static-memory ceilings per registered audit case, the bytes
+# counterpart of STABLE_MAX_COUNTS: max_live_bytes is the measured
+# liveness-scan peak of the compiled program on the tiny registry
+# models (8 virtual CPU devices), frozen exactly — any growth is a
+# regression until adjudicated and re-pinned (shrinkage passes: these
+# are ceilings). max_donated_bytes pins the donated cache/pool argument
+# itself for the serving cases, where its size IS the claim: the dense
+# slot cache and the paged pool are both 65_536 B at the registry's
+# equal-slots config (pool_pages*page_size == slots*max_len — paged
+# wins by allocating FEWER pages, not smaller ones), and the int8 pool
+# is 20_480 B = 0.3125x f32, exactly (head_dim+4)/(4*head_dim) at
+# head_dim 16 (per-token f32 scales amortized over the head); an
+# upcast to f32 lands at 65_536+ and fails donated-bytes-exceeded.
+# max_unaliased_donated_bytes stays at its 0 default everywhere —
+# measured: XLA accepts EVERY donated alias in every program at HEAD.
+# Re-pin procedure: docs/ANALYSIS.md §6.
+STABLE_MEMORY_BUDGETS: dict[str, MemoryBudget] = {
+    "baseline": MemoryBudget(max_live_bytes=4_784_172),
+    "train_guard": MemoryBudget(max_live_bytes=4_783_176),
+    "ddp": MemoryBudget(max_live_bytes=2_458_408),
+    "ddp_bf16": MemoryBudget(
+        max_live_bytes=2_758_952,
+        note="above f32 ddp: the f32 grad accumulator + bf16 activation "
+             "copies coexist at the backward peak on this tiny model",
+    ),
+    "fsdp": MemoryBudget(max_live_bytes=709_868),
+    "zero2": MemoryBudget(max_live_bytes=2_090_536),
+    "fsdp_prefetch": MemoryBudget(
+        max_live_bytes=733_152,
+        note="the +1-layer prefetch window costs ~23 KiB over plain "
+             "fsdp — the bounded-extra-live-bytes overlap claim",
+    ),
+    "zero2_bucketed": MemoryBudget(max_live_bytes=2_090_280),
+    "tp": MemoryBudget(max_live_bytes=1_977_900),
+    "ring": MemoryBudget(max_live_bytes=3_139_616),
+    "ulysses": MemoryBudget(max_live_bytes=2_755_628),
+    "ep": MemoryBudget(max_live_bytes=5_391_952),
+    "pipeline": MemoryBudget(max_live_bytes=3_966_421),
+    "pipeline_1f1b": MemoryBudget(
+        max_live_bytes=1_540_180,
+        note="~0.39x GPipe peak: 1F1B's bounded in-flight microbatches, "
+             "reproduced from static bytes alone",
+    ),
+    "decode_prefill": MemoryBudget(
+        max_live_bytes=554_156, max_donated_bytes=16_384,
+    ),
+    "decode_step": MemoryBudget(
+        max_live_bytes=486_972, max_donated_bytes=16_384,
+    ),
+    "zero3_decode_prefetch": MemoryBudget(
+        max_live_bytes=299_766, max_donated_bytes=16_384,
+    ),
+    "decode_batched_prefill": MemoryBudget(
+        max_live_bytes=619_697, max_donated_bytes=65_536,
+    ),
+    "decode_batched_step": MemoryBudget(
+        max_live_bytes=672_000, max_donated_bytes=65_536,
+    ),
+    "decode_batched_step_tp": MemoryBudget(
+        max_live_bytes=197_760, max_donated_bytes=16_384,
+    ),
+    "decode_paged_prefill": MemoryBudget(
+        max_live_bytes=681_213, max_donated_bytes=65_536,
+    ),
+    "decode_paged_step": MemoryBudget(
+        max_live_bytes=672_000, max_donated_bytes=65_536,
+    ),
+    "decode_paged_prefill_q8": MemoryBudget(
+        max_live_bytes=275_461, max_donated_bytes=20_480,
+        note="int8 pool + per-token scales: 0.3125x the f32 pool at "
+             "head_dim 16; an f32 upcast fails donated-bytes-exceeded",
+    ),
+    "decode_paged_step_q8": MemoryBudget(
+        max_live_bytes=267_656, max_donated_bytes=20_480,
+        note="int8 pool + per-token scales: 0.3125x the f32 pool at "
+             "head_dim 16; an f32 upcast fails donated-bytes-exceeded",
+    ),
+    "decode_batched_step_tp_q8": MemoryBudget(
+        max_live_bytes=125_952, max_donated_bytes=16_384,
+    ),
+    "decode_batched_spec_step": MemoryBudget(
+        max_live_bytes=699_984, max_donated_bytes=65_536,
+    ),
+    "decode_paged_spec_step": MemoryBudget(
+        max_live_bytes=700_016, max_donated_bytes=65_536,
+    ),
+    "decode_batched_step_tp_spec": MemoryBudget(
+        max_live_bytes=211_920, max_donated_bytes=16_384,
+    ),
+    "decode_paged_prefill_lora": MemoryBudget(
+        max_live_bytes=705_794, max_donated_bytes=65_536,
+    ),
+    "decode_paged_step_lora": MemoryBudget(
+        max_live_bytes=696_612, max_donated_bytes=65_536,
+    ),
+    "decode_batched_step_tp_lora": MemoryBudget(
+        max_live_bytes=213_156, max_donated_bytes=16_384,
+    ),
+    "ddp_pjit": MemoryBudget(max_live_bytes=2_458_808),
+    "fsdp_pjit": MemoryBudget(max_live_bytes=1_094_776),
+    "zero2_pjit": MemoryBudget(max_live_bytes=1_558_768),
+    "tp_pjit": MemoryBudget(max_live_bytes=1_977_900),
+    "ring_pjit": MemoryBudget(max_live_bytes=2_737_788),
+    "ep_pjit": MemoryBudget(max_live_bytes=6_461_028),
+}
+
+
+def memory_budget_for(case: str) -> MemoryBudget:
+    """The pinned STABLE_MEMORY_BUDGETS entry for ``case``.
+
+    KeyError (with the fix spelled out) when the case has no pin: every
+    registered program must carry a memory budget, so a new engine
+    program cannot ship audit-unpinned.
+    """
+    try:
+        return STABLE_MEMORY_BUDGETS[case]
+    except KeyError:
+        raise KeyError(
+            f"no pinned memory budget for registered case {case!r} — "
+            "measure it (scripts/audit.py --case "
+            f"{case} --only memory --json r.json, read "
+            "summary.memory) and add a STABLE_MEMORY_BUDGETS entry "
+            "(docs/ANALYSIS.md §6 documents the re-pin procedure)"
+        ) from None
 
 
 def expected_budget(

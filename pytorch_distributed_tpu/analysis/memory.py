@@ -1,0 +1,559 @@
+"""Static peak-HBM estimation over optimized HLO text.
+
+The serving/perf claims since PR 8 are *bytes* claims — the paged pool is
+smaller than the dense cache, int8 pages are ~0.28x their f32 twins,
+donation keeps the KV cache single-buffered — but none of that was
+statically contractual: a regression that breaks an input/output alias or
+doubles a live buffer only surfaces as a runtime OOM on hardware the CPU
+rig does not have. This module prices the compiled artifact instead: it
+parses the post-scheduling HLO module text (the same ``compiled.as_text()``
+the collective/donation checks already consume) and derives a peak
+live-bytes estimate per computation from buffer sizes + a liveness linear
+scan.
+
+Model (and its honest limits):
+
+- **Buffer sizes** come from each instruction's declared result shape
+  (``f32[4,16]{1,0}`` -> 256 bytes, tuples sum their components,
+  sub-byte dtypes round up to whole bytes).
+- **Liveness** is a linear scan over the instruction order of each
+  computation. The module header carries ``is_scheduled=true``: the text
+  order IS the execution order (the same property
+  ``hlo.async_collective_pairs`` relies on), so "defined at i, last used
+  at j" brackets the interval the buffer occupies memory. Peak = the
+  maximum over program points of the live-interval byte sum.
+- **Aliasing**: ``get-tuple-element``/``bitcast`` results are views, a
+  ``tuple`` is a table over its operands, and a ``while`` loops in place
+  over its carry buffer — none of them allocate; their uses count as uses
+  of the underlying buffer(s).
+- **Donation** (``input_output_alias`` in the module header) is honored
+  as bytes actually saved: an output component that XLA aliased to a
+  donated parameter writes INTO the parameter's buffer, so the output's
+  own allocation is credited away and the parameter stays live to the
+  end. ``alias_saved_bytes`` reports exactly how many peak bytes donation
+  bought — the number that silently becomes 0 when a shape change makes
+  XLA reject the alias.
+- **Scoping**: every named computation (while bodies/conds, fusion
+  bodies, reduce applicators, conditional branches) gets its own
+  estimate, so a decode loop's steady-state footprint is separable from
+  the prefill around it. In the parent scan, a ``while``/``conditional``
+  instruction contributes its body's *internal* temporaries (body peak
+  minus the carry the parent already counts) at its program point;
+  fusion internals never materialize and contribute only the fusion's
+  result buffer.
+
+What this is NOT: the runtime allocator. XLA's buffer assignment packs
+temp buffers into reused slabs, pads for layout, and on TPU tiles to
+(8, 128) lanes — measured ``peak_bytes_in_use`` on hardware can sit above
+(padding, fragmentation) or below (slab reuse across disjoint intervals
+this scan keeps separate) the static estimate. The estimate is a
+*monotone proxy*: a regression that doubles a live buffer or un-aliases a
+donated input moves it loudly in the right direction, which is what the
+pinned ceilings in ``budget.STABLE_MEMORY_BUDGETS`` enforce. For the
+allocator's own numbers, see ``profiling/memory.compiled_memory_analysis``
+(XLA buffer assignment) — the cross-check, not the contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+
+from pytorch_distributed_tpu.analysis.hlo import parse_input_output_aliases
+
+# Bit widths per HLO primitive type. pred is stored as a byte; sub-byte
+# int4/uint4 pack two to a byte (rounded up per buffer); token/opaque
+# occupy no HBM.
+_DTYPE_BITS = {
+    "pred": 8,
+    "s2": 2, "u2": 2, "s4": 4, "u4": 4,
+    "s8": 8, "u8": 8,
+    "s16": 16, "u16": 16,
+    "s32": 32, "u32": 32,
+    "s64": 64, "u64": 64,
+    "f16": 16, "bf16": 16,
+    "f32": 32, "f64": 64,
+    "c64": 64, "c128": 128,
+    "f8e4m3": 8, "f8e4m3fn": 8, "f8e4m3b11fnuz": 8, "f8e4m3fnuz": 8,
+    "f8e5m2": 8, "f8e5m2fnuz": 8, "f8e3m4": 8, "f8e8m0fnu": 8,
+    "f4e2m1fn": 4,
+    "token": 0, "opaque": 0,
+}
+
+# `f32[4,16]{1,0:T(8,128)}` — dims then an optional layout block (TPU
+# layouts carry tiling after a colon; braces do not nest).
+_ARRAY_SHAPE_RE = re.compile(r"([a-z]\w*)\[([\d,]*)\](?:\{[^}]*\})?")
+
+_INSTR_LINE_RE = re.compile(r"^\s*(ROOT\s+)?%?([\w.\-]+)\s*=\s*")
+
+# `%name (args) -> type {` / `ENTRY %name (args) -> type {`
+_COMP_HEAD_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s+\(.*\{\s*$")
+
+# Computation references in instruction attributes: the attr name tells
+# the callee's role (used to classify computations and to decide whose
+# internal temporaries surface into the parent scan). Single-name attrs
+# (`body=%region_0.19`) and the brace-list form
+# (`branch_computations={%a, %b}`) are separate patterns so one attr's
+# capture cannot swallow the next attr's name.
+_CALLED_COMP_RE = re.compile(
+    r"(calls|to_apply|condition|body|true_computation|"
+    r"false_computation)=%?([\w.\-]+)"
+)
+_BRANCHES_RE = re.compile(r"(branch_computations)=\{([^}]*)\}")
+
+# Results of these opcodes are views over (some of) their operands, not
+# fresh allocations.
+_VIEW_OPCODES = frozenset({"get-tuple-element", "bitcast"})
+
+
+def shape_bytes(shape: str) -> int:
+    """Byte size of one HLO shape string (array or tuple).
+
+    ``f32[4,16]{1,0}`` -> 256; ``(s32[], f32[8]{0})`` -> 36; scalars are
+    rank-0 arrays (``f32[]`` -> 4); sub-byte element types round the
+    whole buffer up to bytes.
+    """
+    shape = shape.strip()
+    if shape.startswith("("):
+        return sum(
+            shape_bytes(part) for part in _split_tuple(shape)
+        )
+    m = _ARRAY_SHAPE_RE.match(shape)
+    if not m:
+        return 0
+    dtype, dims = m.group(1), m.group(2)
+    bits = _DTYPE_BITS.get(dtype)
+    if bits is None:
+        return 0
+    n = 1
+    for d in dims.split(","):
+        if d.strip():
+            n *= int(d)
+    return math.ceil(n * bits / 8)
+
+
+def _split_tuple(shape: str) -> list[str]:
+    """Top-level components of ``(a, b, (c, d))`` (paren-aware)."""
+    body = shape.strip()[1:-1]
+    parts, depth, start = [], 0, 0
+    for i, ch in enumerate(body):
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(body[start:i])
+            start = i + 1
+    if body[start:].strip():
+        parts.append(body[start:])
+    return parts
+
+
+def _scan_shape(text: str) -> tuple[str, int] | None:
+    """(shape string, end offset) at the start of ``text``: a balanced
+    paren scan for tuple types, the array regex otherwise."""
+    if text.startswith("("):
+        depth = 0
+        for i, ch in enumerate(text):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    return text[: i + 1], i + 1
+        return None
+    m = _ARRAY_SHAPE_RE.match(text)
+    if m:
+        return m.group(0), m.end()
+    return None
+
+
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+_OPCODE_RE = re.compile(r"\s*([\w\-]+)")
+
+
+def _called_attr_pairs(text: str):
+    """(role attr, callee name) pairs referenced in ``text``."""
+    for cm in _CALLED_COMP_RE.finditer(text):
+        yield cm.group(1), cm.group(2)
+    for cm in _BRANCHES_RE.finditer(text):
+        for n in re.split(r"[,\s]+", cm.group(2)):
+            n = n.strip("% ")
+            if n:
+                yield cm.group(1), n
+
+
+def _called_computations(text: str) -> list[str]:
+    return [name for _, name in _called_attr_pairs(text)]
+
+
+@dataclasses.dataclass(frozen=True)
+class HloInstruction:
+    """One parsed instruction line of a computation body."""
+
+    name: str
+    shape: str
+    bytes: int
+    opcode: str
+    operands: tuple[str, ...]
+    called: tuple[str, ...]  # computations referenced via attrs
+    is_root: bool
+    param_number: int | None  # for opcode == "parameter"
+
+
+@dataclasses.dataclass(frozen=True)
+class HloComputation:
+    name: str
+    is_entry: bool
+    instructions: tuple[HloInstruction, ...]
+
+    @property
+    def root(self) -> HloInstruction:
+        for instr in self.instructions:
+            if instr.is_root:
+                return instr
+        return self.instructions[-1]
+
+
+@dataclasses.dataclass(frozen=True)
+class HloModule:
+    header: str
+    computations: dict[str, HloComputation]
+    entry: HloComputation
+    # computation name -> role attr it was referenced through
+    # ("body", "condition", "calls", "to_apply", ...)
+    roles: dict[str, str]
+
+
+def _parse_instruction(line: str) -> HloInstruction | None:
+    m = _INSTR_LINE_RE.match(line)
+    if not m:
+        return None
+    is_root, name = bool(m.group(1)), m.group(2)
+    rest = line[m.end():]
+    scanned = _scan_shape(rest)
+    if scanned is None:
+        return None
+    shape, off = scanned
+    rest = rest[off:]
+    om = _OPCODE_RE.match(rest)
+    if not om:
+        return None
+    opcode = om.group(1)
+    rest = rest[om.end():]
+    # Operand body: balanced parens right after the opcode. Attrs follow.
+    operands: tuple[str, ...] = ()
+    param_number = None
+    attrs = rest
+    if rest.startswith("("):
+        depth, end = 0, len(rest)
+        for i, ch in enumerate(rest):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    end = i + 1
+                    break
+        body, attrs = rest[1:end - 1], rest[end:]
+        operands = tuple(_OPERAND_NAME_RE.findall(body))
+        if opcode == "parameter":
+            try:
+                param_number = int(body.strip())
+            except ValueError:
+                param_number = None
+    called = tuple(_called_computations(attrs))
+    return HloInstruction(
+        name=name, shape=shape, bytes=shape_bytes(shape), opcode=opcode,
+        operands=operands, called=called, is_root=is_root,
+        param_number=param_number,
+    )
+
+
+def parse_module(hlo_text: str) -> HloModule:
+    """Split compiled-module text into its computations.
+
+    Raises ``ValueError`` when no ENTRY computation is found — an audit
+    that silently estimated nothing would be worse than one that fails.
+    """
+    lines = hlo_text.splitlines()
+    header = lines[0] if lines else ""
+    computations: dict[str, HloComputation] = {}
+    entry: HloComputation | None = None
+    current: tuple[str, bool, list[HloInstruction]] | None = None
+    for line in lines[1:]:
+        stripped = line.strip()
+        if current is None:
+            cm = _COMP_HEAD_RE.match(stripped)
+            if cm and "=" not in stripped.split("(", 1)[0]:
+                current = (cm.group(2), bool(cm.group(1)), [])
+            continue
+        if stripped == "}":
+            name, is_entry, instrs = current
+            comp = HloComputation(
+                name=name, is_entry=is_entry, instructions=tuple(instrs)
+            )
+            computations[name] = comp
+            if is_entry:
+                entry = comp
+            current = None
+            continue
+        instr = _parse_instruction(line)
+        if instr is not None:
+            current[2].append(instr)
+    if entry is None:
+        raise ValueError("no ENTRY computation in HLO module text")
+    # Roles come from the attr names (the instruction only kept the
+    # callee names); a second cheap pass over the text keeps
+    # HloInstruction flat.
+    roles: dict[str, str] = {}
+    for line in lines:
+        for role, n in _called_attr_pairs(line):
+            roles.setdefault(n, role)
+    return HloModule(
+        header=header, computations=computations, entry=entry, roles=roles
+    )
+
+
+_ROLE_KIND = {
+    "body": "while-body",
+    "condition": "while-cond",
+    "calls": "fusion",
+    "to_apply": "reduce",
+    "true_computation": "branch",
+    "false_computation": "branch",
+    "branch_computations": "branch",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ComputationEstimate:
+    """Liveness-scan result for one computation."""
+
+    name: str
+    kind: str  # "entry" | "while-body" | "while-cond" | "fusion" | ...
+    peak_live_bytes: int
+    parameter_bytes: int
+    output_bytes: int
+    n_instructions: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HloParameter:
+    name: str
+    shape: str
+    bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryEstimate:
+    """Static peak-HBM estimate for one compiled module."""
+
+    entry: ComputationEstimate  # alias-credited
+    raw_peak_bytes: int  # entry peak with NO alias credit
+    alias_saved_bytes: int  # raw_peak_bytes - entry.peak_live_bytes
+    parameters: dict[int, HloParameter]  # entry params by number
+    aliased_params: frozenset[int]  # params with an accepted output alias
+    computations: dict[str, ComputationEstimate]  # every non-entry comp
+
+    @property
+    def peak_live_bytes(self) -> int:
+        return self.entry.peak_live_bytes
+
+    @property
+    def parameter_bytes(self) -> int:
+        return sum(p.bytes for p in self.parameters.values())
+
+    def param_bytes(self, numbers) -> int:
+        """Total bytes of the named entry parameters (e.g. a donated
+        argument's contiguous leaf run)."""
+        return sum(
+            self.parameters[n].bytes for n in numbers
+            if n in self.parameters
+        )
+
+    def loop_bodies(self) -> dict[str, ComputationEstimate]:
+        """The while-body computations: the decode loop's steady-state
+        scope, separable from the prefill/entry around it."""
+        return {
+            n: c for n, c in self.computations.items()
+            if c.kind == "while-body"
+        }
+
+
+def _underlying(comp: HloComputation) -> dict[str, frozenset]:
+    """name -> the set of allocating buffers the value aliases.
+
+    get-tuple-element/bitcast view their first operand; a tuple keeps all
+    its operands reachable; a while iterates in place over its carry
+    operand. Everything else (including parameters) is its own buffer.
+    """
+    under: dict[str, frozenset] = {}
+
+    def resolve(name: str) -> frozenset:
+        return under.get(name, frozenset({name}))
+
+    for instr in comp.instructions:
+        if instr.opcode in _VIEW_OPCODES and instr.operands:
+            under[instr.name] = resolve(instr.operands[0])
+        elif instr.opcode in ("tuple", "while") and instr.operands:
+            merged: frozenset = frozenset()
+            for op in instr.operands:
+                merged |= resolve(op)
+            under[instr.name] = merged
+        else:
+            under[instr.name] = frozenset({instr.name})
+    return under
+
+
+def _estimate_computation(
+    comp: HloComputation,
+    *,
+    kind: str,
+    alias_entries=(),
+    extra_at: dict[int, int] | None = None,
+) -> ComputationEstimate:
+    """Linear-scan liveness over one computation's instruction order.
+
+    ``alias_entries``: accepted input_output_alias entries (entry
+    computation only) — each one credits the aliased output component's
+    buffer away (it writes into the donated parameter's buffer) and pins
+    the parameter live to the end.
+    ``extra_at``: instruction index -> extra transient bytes live at that
+    point (a while/conditional's internal body temporaries).
+    """
+    under = _underlying(comp)
+    instrs = comp.instructions
+    index = {instr.name: i for i, instr in enumerate(instrs)}
+    sizes = {
+        instr.name: instr.bytes
+        for instr in instrs
+        if under.get(instr.name) == frozenset({instr.name})
+    }
+    param_bytes = sum(
+        i.bytes for i in instrs if i.opcode == "parameter"
+    )
+
+    # Donation credit: the output component's buffer writes in place into
+    # the donated parameter, so it stops being its own allocation.
+    params_by_number = {
+        i.param_number: i.name
+        for i in instrs
+        if i.opcode == "parameter" and i.param_number is not None
+    }
+    root = comp.root
+    pinned_to_end: set[str] = set(under.get(root.name, {root.name}))
+    for entry_alias in alias_entries:
+        if entry_alias.param_index:
+            continue  # nested donated leaves: no credit (conservative)
+        pname = params_by_number.get(entry_alias.param_number)
+        if pname is None:
+            continue
+        out_name = root.name
+        if root.opcode == "tuple" and len(entry_alias.output_index) == 1:
+            oi = entry_alias.output_index[0]
+            if oi < len(root.operands):
+                out_name = root.operands[oi]
+        elif entry_alias.output_index:
+            continue  # deeper nesting: no credit (conservative)
+        bufs = under.get(out_name, frozenset({out_name}))
+        if len(bufs) != 1:
+            continue
+        (buf,) = bufs
+        if buf != pname and buf in sizes:
+            sizes[buf] = 0
+            pinned_to_end.add(pname)
+
+    n = len(instrs)
+    last_use: dict[str, int] = {}
+    for i, instr in enumerate(instrs):
+        for op in instr.operands:
+            for buf in under.get(op, frozenset({op})):
+                last_use[buf] = i
+    for buf in pinned_to_end:
+        last_use[buf] = n
+    # Parameters are materialized before the first instruction runs.
+    delta = [0] * (n + 2)
+    for instr in instrs:
+        buf = instr.name
+        if under.get(buf) != frozenset({buf}):
+            continue
+        size = sizes.get(buf, 0)
+        if size == 0:
+            continue
+        start = 0 if instr.opcode == "parameter" else index[buf]
+        end = last_use.get(buf, index[buf])
+        delta[start] += size
+        delta[end + 1] -= size
+    peak, live = 0, 0
+    for i in range(n + 1):
+        live += delta[i]
+        here = live + (extra_at or {}).get(i, 0)
+        if here > peak:
+            peak = here
+    return ComputationEstimate(
+        name=comp.name,
+        kind=kind,
+        peak_live_bytes=peak,
+        parameter_bytes=param_bytes,
+        output_bytes=root.bytes,
+        n_instructions=n,
+    )
+
+
+def estimate_memory(hlo_text: str) -> MemoryEstimate:
+    """Static peak-HBM estimate of a compiled module (see module doc)."""
+    module = parse_module(hlo_text)
+    aliases = parse_input_output_aliases(hlo_text)
+
+    computations: dict[str, ComputationEstimate] = {}
+    for name, comp in module.computations.items():
+        if comp.is_entry:
+            continue
+        kind = _ROLE_KIND.get(module.roles.get(name, ""), "computation")
+        computations[name] = _estimate_computation(comp, kind=kind)
+
+    # While/conditional bodies allocate their internal temporaries while
+    # the parent is parked on the while/conditional instruction; surface
+    # them at that program point (carry/operand bytes are already the
+    # parent's buffers — subtract the body's parameters).
+    extra_at: dict[int, int] = {}
+    for i, instr in enumerate(module.entry.instructions):
+        if instr.opcode not in ("while", "conditional"):
+            continue
+        extra = 0
+        for callee in instr.called:
+            est = computations.get(callee)
+            if est is not None:
+                extra = max(
+                    extra,
+                    est.peak_live_bytes - est.parameter_bytes,
+                )
+        if extra > 0:
+            extra_at[i] = extra_at.get(i, 0) + extra
+
+    entry_raw = _estimate_computation(
+        module.entry, kind="entry", extra_at=extra_at
+    )
+    entry_credited = _estimate_computation(
+        module.entry, kind="entry", alias_entries=aliases,
+        extra_at=extra_at,
+    )
+    parameters = {
+        i.param_number: HloParameter(
+            name=i.name, shape=i.shape, bytes=i.bytes
+        )
+        for i in module.entry.instructions
+        if i.opcode == "parameter" and i.param_number is not None
+    }
+    return MemoryEstimate(
+        entry=entry_credited,
+        raw_peak_bytes=entry_raw.peak_live_bytes,
+        alias_saved_bytes=(
+            entry_raw.peak_live_bytes - entry_credited.peak_live_bytes
+        ),
+        parameters=parameters,
+        aliased_params=frozenset(e.param_number for e in aliases),
+        computations=computations,
+    )

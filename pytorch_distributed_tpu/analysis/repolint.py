@@ -23,6 +23,16 @@ bare allow is itself a violation):
   ``jax.debug.callback`` in library code (``pytorch_distributed_tpu/``)
   must be allowlisted: each firing is a host round-trip
   (scripts/ and tests/ may debug freely).
+- ``blocking-sync-in-tick`` — no blocking device reads
+  (``jax.device_get`` / ``np.asarray`` / ``np.array`` / ``.item()`` /
+  ``.block_until_ready()``) inside the serving scheduler's tick path
+  (``pytorch_distributed_tpu/serving/``: step/run/_admit/_prefill_group/
+  _chunk_prefill_tick/_decode_tick/_decode_tick_spec/_dispatch). Every
+  such read stalls the scheduler until the device drains — the
+  continuous-batching design keeps exactly ONE adjudicated sync per tick
+  (the dispatch-boundary output read), and that one carries an
+  allow-comment with its reason. These are HOST functions, so the
+  traced-body rules above never see them.
 
 Run: ``python -m pytorch_distributed_tpu.analysis.repolint [paths...]``
 (default: the package + scripts/). Exit code 1 on any violation — wired
@@ -45,7 +55,18 @@ RULES = (
     "host-sync-in-traced",
     "wallclock-in-traced",
     "debug-callback-in-library",
+    "blocking-sync-in-tick",
 )
+
+# The serving scheduler's tick path: methods on the hot engine loop
+# (serving/engine.py) between "requests wait" and "tokens stream out".
+# A blocking device read anywhere in here serialises the whole tick.
+_TICK_PATH_FUNCS = frozenset({
+    "step", "run", "_admit", "_prefill_group", "_chunk_prefill_tick",
+    "_decode_tick", "_decode_tick_spec", "_dispatch",
+})
+# Method attrs that force a device sync on whatever they are called on.
+_SYNC_ATTRS = frozenset({"item", "block_until_ready"})
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,7 +204,8 @@ _DEBUG_CALLS = ("jax.debug.print", "jax.debug.callback", "io_callback",
 
 
 def lint_source(
-    source: str, path: str, *, library: bool = False
+    source: str, path: str, *, library: bool = False,
+    serving: bool | None = None,
 ) -> list[Violation]:
     lines = source.splitlines()
     try:
@@ -255,6 +277,44 @@ def lint_source(
                     "evaluates once at trace time, frozen thereafter",
                     end_lineno=getattr(node, "end_lineno", None),
                 )
+
+    # Rule: blocking syncs in the serving tick path. Host code, so the
+    # traced-body walk above is blind to it: a `.item()` in _admit is a
+    # legal Python program that quietly drains the device every tick.
+    if serving is None:
+        serving = path.replace("\\", "/").startswith(
+            "pytorch_distributed_tpu/serving/"
+        )
+    if serving:
+        tick_fns = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name in _TICK_PATH_FUNCS
+        ]
+        for fn in tick_fns:
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _dotted(node.func)
+                sync = None
+                if name in _HOST_SYNC_CALLS or name == "jax.device_get":
+                    sync = f"{name}()"
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _SYNC_ATTRS
+                    and not node.args
+                ):
+                    sync = f".{node.func.attr}()"
+                if sync is not None:
+                    add(
+                        "blocking-sync-in-tick",
+                        node.lineno,
+                        f"{sync} inside scheduler tick path "
+                        f"{fn.name!r}: blocks the tick until the device "
+                        "drains — keep the loop async and allowlist only "
+                        "the adjudicated dispatch-boundary read",
+                        end_lineno=getattr(node, "end_lineno", None),
+                    )
 
     # Rule: debug callbacks in library code (anywhere in the module, traced
     # or not — library modules should not ship debug prints).

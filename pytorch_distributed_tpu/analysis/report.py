@@ -9,6 +9,14 @@ from typing import Any
 SEVERITIES = ("error", "warn", "info")
 
 
+def _fmt_bytes(n: int) -> str:
+    if n >= 2**20:
+        return f"{n / 2**20:.2f} MiB"
+    if n >= 2**10:
+        return f"{n / 2**10:.1f} KiB"
+    return f"{n} B"
+
+
 @dataclasses.dataclass(frozen=True)
 class Finding:
     """One audit observation.
@@ -83,6 +91,17 @@ class AuditReport:
             lines.append(
                 "  donation:    {aliased}/{expected} state buffers aliased"
                 .format(**don)
+            )
+        mem = self.summary.get("memory")
+        if mem is not None:
+            lines.append(
+                "  memory:      peak {} live ({} saved by aliasing), "
+                "{} donated / {} unaliased".format(
+                    _fmt_bytes(mem.get("peak_live_bytes", 0)),
+                    _fmt_bytes(mem.get("alias_saved_bytes", 0)),
+                    _fmt_bytes(mem.get("donated_bytes", 0)),
+                    _fmt_bytes(mem.get("unaliased_donated_bytes", 0)),
+                )
             )
         dots = self.summary.get("dot_dtypes")
         if dots:

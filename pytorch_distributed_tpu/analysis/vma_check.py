@@ -79,10 +79,14 @@ Reported findings (``checker="vma"``):
 
 Known false-negative classes (documented in docs/ANALYSIS.md): on pre-vma
 jax the pcast/pvary shims are identity, so rule 4 only engages on post-vma
-jaxprs; ``axis_index_groups`` are treated as the full axis; primitives
-with sub-jaxprs the interpreter cannot map positionally fall back to the
-conservative join (over-approximating vma never hides a race, but the
-body's internal findings are skipped — counted in ``summary["opaque"]``).
+jaxprs; primitives with sub-jaxprs the interpreter cannot map positionally
+fall back to the conservative join (over-approximating vma never hides a
+race, but the body's internal findings are skipped — counted in
+``summary["opaque"]``). Grouped collectives (``axis_index_groups``) are
+typed as still-varying over their axes — a grouped psum replicates only
+within each group, so treating it as the full axis (the old behaviour)
+would hide cross-group out_spec races; a later full-axis psum is what
+discharges the varying bit.
 """
 
 from __future__ import annotations
@@ -301,6 +305,19 @@ class VmaInterpreter:
         if name in _REDUCE_PRIMS:
             axes = frozenset(_axis_names(eqn.params))
             self._check_divergence(eqn, axes, divergent, record)
+            grouped = eqn.params.get("axis_index_groups") is not None
+            if grouped:
+                # A grouped reduction replicates only WITHIN each group:
+                # members of different groups hold different sums, so the
+                # result still varies over the named axes. Joining the
+                # axes in (instead of subtracting them) keeps a
+                # downstream ungrouped-psum requirement live — the old
+                # full-axis treatment typed grouped psums as replicated
+                # and silently passed out_specs that race across groups.
+                # No redundant-collective warn either: invariance over
+                # the full axis does not make a WITHIN-group reduction
+                # redundant evidence we can judge here.
+                return [(s | axes, const) for s, const in ins]
             outs = []
             for v, (s, const) in zip(eqn.invars, ins):
                 dead = axes - s

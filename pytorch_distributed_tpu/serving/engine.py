@@ -2366,6 +2366,11 @@ class BatchedDecodeEngine:
             return None
         self._cache = cache
         self._fail_streak = 0
+        # repolint: allow(blocking-sync-in-tick) — the adjudicated
+        # dispatch-boundary read: the scheduler needs this tick's tokens
+        # and sentinel ON HOST to route/retire rows before it can build
+        # the next dispatch, so exactly one sync per tick is the design
+        # (everything upstream stays async; the cache stays on device).
         return tuple(np.asarray(o) for o in outs)
 
     def _recover_dispatch_failure(self, kind, err, group_pendings,

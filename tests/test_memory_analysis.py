@@ -1,0 +1,386 @@
+"""Static HBM liveness estimator + MemoryBudget contract (analysis/memory).
+
+Four layers, mirroring how the collective budgets are tested:
+
+1. parser units — shape byte accounting and module structure on
+   synthetic HLO text (no compiler in the loop);
+2. liveness + alias credit on real compiled toys — donation shows up as
+   bytes actually saved, and a donation XLA REJECTS is an audit error
+   naming the exact parameter (the tooth donation_strict lacks: it
+   verifies intent, check_memory verifies consequence);
+3. the pinned-table gates — every registered case has a
+   STABLE_MEMORY_BUDGETS pin and vice versa, plus the engine coverage
+   map (every program kind an engine can dispatch maps to registered
+   cases, so new engine programs cannot ship audit-unpinned);
+4. the pool-ratio claims re-derived from HLO alone — paged <= dense at
+   the equal-slots config, int8 pool ~= 0.28x f32 at head_dim 32 — and
+   the negative: an f32 pool audited under the int8 contract fails
+   donated-bytes-exceeded (the injected-upcast scenario).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from pytorch_distributed_tpu.analysis.audit import (
+    audit_program,
+    donated_param_numbers,
+)
+from pytorch_distributed_tpu.analysis.budget import (
+    STABLE_MEMORY_BUDGETS,
+    MemoryBudget,
+    check_memory,
+    memory_budget_for,
+)
+from pytorch_distributed_tpu.analysis.memory import (
+    estimate_memory,
+    parse_module,
+    shape_bytes,
+)
+from pytorch_distributed_tpu.analysis.registry import (
+    ENGINE_PROGRAM_CASES,
+    registered_cases,
+)
+from pytorch_distributed_tpu.config import ModelConfig
+
+
+# --------------------------------------------------------------------------
+# 1. parser units
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "shape,expect",
+    [
+        ("f32[4,16]{1,0}", 256),
+        ("bf16[2,3]", 12),
+        ("s8[10]", 10),
+        ("pred[]", 1),
+        ("s4[3]", 2),  # sub-byte packs: ceil(3*4/8)
+        ("u32[]", 4),
+        ("token[]", 0),
+        ("(s32[], f32[8]{0})", 36),
+        # commas inside dims must not split tuple components
+        ("(s32[], f32[4,16]{1,0}, f32[4,16]{1,0})", 516),
+    ],
+)
+def test_shape_bytes(shape, expect):
+    assert shape_bytes(shape) == expect
+
+
+_SYNTH = """\
+HloModule synth, is_scheduled=true, entry_computation_layout={(f32[4,4]{1,0})->f32[4,4]{1,0}}
+
+ENTRY %main (p0.1: f32[4,4]) -> f32[4,4] {
+  %p0.1 = f32[4,4]{1,0} parameter(0)
+  %a = f32[4,4]{1,0} add(%p0.1, %p0.1)
+  %b = f32[4,4]{1,0} multiply(%a, %a)
+  ROOT %c = f32[4,4]{1,0} add(%b, %a)
+}
+"""
+
+
+def test_parse_synthetic_module():
+    mod = parse_module(_SYNTH)
+    assert mod.entry.name == "main"
+    instrs = {i.name: i for i in mod.entry.instructions}
+    assert instrs["p0.1"].param_number == 0
+    assert instrs["c"].is_root
+    assert instrs["b"].operands == ("a", "a")
+    assert all(i.bytes == 64 for i in mod.entry.instructions)
+
+
+def test_parse_requires_entry():
+    with pytest.raises(ValueError):
+        parse_module("HloModule nothing\n")
+
+
+def test_synthetic_liveness_peak():
+    est = estimate_memory(_SYNTH)
+    # Tightest point: %b's definition, where %a (operand), %b (result)
+    # and %p0.1 (still live until freed after its last use at %a's
+    # point) have not all been released: 3 x 64 B. The root is pinned
+    # live to the end but %a and %p0.1 are dead by then.
+    assert est.raw_peak_bytes == 192
+    assert est.alias_saved_bytes == 0  # no input_output_alias header
+    assert est.parameters[0].bytes == 64
+
+
+# --------------------------------------------------------------------------
+# 2. alias credit + the rejected-donation tooth on real compiled programs
+# --------------------------------------------------------------------------
+
+
+def _compiled_text(fn, args, donate=(0,)):
+    jitted = jax.jit(fn, donate_argnums=donate)
+    return jitted, jitted.lower(*args).compile().as_text()
+
+
+def test_alias_credit_bytes_actually_saved():
+    # Param-dominated program: donating the 1 MiB weight must show up as
+    # roughly its size saved at the end-of-program double-buffer point.
+    w = jnp.ones((512, 512), jnp.float32)  # 1 MiB
+
+    def step(w):
+        return w * 0.5 + 1.0
+
+    _, text = _compiled_text(step, (w,))
+    est = estimate_memory(text)
+    assert 0 in est.aliased_params
+    assert est.alias_saved_bytes >= w.nbytes // 2
+    assert est.peak_live_bytes < est.raw_peak_bytes
+
+
+def test_rejected_donation_names_the_parameter():
+    # The output dtype differs from the donated input, so XLA cannot
+    # alias the buffers: the donation is silently rejected and the
+    # program double-buffers. check_memory must error AND name the
+    # parameter (number, shape, bytes) — not just count it.
+    w = jnp.ones((64, 64), jnp.float32)
+
+    def step(w):
+        return (w * 0.5).astype(jnp.bfloat16)
+
+    _, text = _compiled_text(step, (w,))
+    est = estimate_memory(text)
+    assert 0 not in est.aliased_params
+    findings, stats = check_memory(
+        est, MemoryBudget(), donated_params=frozenset({0})
+    )
+    assert stats["unaliased_donated_bytes"] == w.nbytes
+    [f] = [f for f in findings if f.code == "donated-param-not-aliased"]
+    assert f.severity == "error"
+    assert f.detail["param_number"] == 0
+    assert f.detail["bytes"] == w.nbytes
+    assert "f32[64,64]" in f.detail["shape"]
+
+
+def test_audit_program_memory_check_end_to_end():
+    # Through audit_program itself: the broken-donation twin fails the
+    # memory check, the healthy twin passes it, and summary["memory"]
+    # carries the static stats either way.
+    w = jnp.ones((64, 64), jnp.float32)
+
+    good = jax.jit(lambda w: w * 2.0, donate_argnums=(0,))
+    bad = jax.jit(
+        lambda w: (w * 2.0).astype(jnp.bfloat16), donate_argnums=(0,)
+    )
+
+    r_good = audit_program(
+        good, (w,), None, checks=("memory",), label="good"
+    )
+    assert r_good.clean()
+    assert r_good.summary["memory"]["unaliased_donated_bytes"] == 0
+
+    r_bad = audit_program(bad, (w,), None, checks=("memory",), label="bad")
+    assert not r_bad.clean()
+    assert any(
+        f.code == "donated-param-not-aliased" for f in r_bad.errors
+    )
+
+
+def test_loop_body_scoping():
+    # The decode-loop separability claim in miniature: a while body's
+    # peak is reported per-computation, and its internal temporaries
+    # surface at the parent's while instruction (extra_at), so the
+    # entry peak covers them without the fusion internals leaking.
+    w = jnp.ones((64, 64), jnp.float32)
+
+    def step(w):
+        def body(_, acc):
+            return acc @ acc + 1.0
+
+        return jax.lax.fori_loop(0, 4, body, w)
+
+    _, text = _compiled_text(step, (w,))
+    est = estimate_memory(text)
+    bodies = est.loop_bodies()
+    assert bodies, "compiled fori_loop must surface a while body"
+    assert all(b.peak_live_bytes > 0 for b in bodies.values())
+    assert est.peak_live_bytes >= max(
+        b.peak_live_bytes - b.parameter_bytes for b in bodies.values()
+    )
+
+
+def test_memory_budget_ceiling_trips():
+    w = jnp.ones((64, 64), jnp.float32)
+    _, text = _compiled_text(lambda w: w * 2.0, (w,))
+    est = estimate_memory(text)
+    findings, _ = check_memory(
+        est,
+        MemoryBudget(max_live_bytes=est.peak_live_bytes - 1),
+        donated_params=frozenset({0}),
+    )
+    assert [f.code for f in findings] == ["memory-budget-exceeded"]
+    assert findings[0].severity == "error"
+    # At the pinned value exactly: clean (ceilings are inclusive).
+    findings, _ = check_memory(
+        est,
+        MemoryBudget(max_live_bytes=est.peak_live_bytes),
+        donated_params=frozenset({0}),
+    )
+    assert findings == []
+
+
+# --------------------------------------------------------------------------
+# 3. pinned-table + engine coverage gates
+# --------------------------------------------------------------------------
+
+
+def test_every_registered_case_has_a_memory_pin():
+    cases = set(registered_cases())
+    pinned = set(STABLE_MEMORY_BUDGETS)
+    assert cases - pinned == set(), (
+        "registered cases without a STABLE_MEMORY_BUDGETS pin"
+    )
+    assert pinned - cases == set(), (
+        "stale STABLE_MEMORY_BUDGETS entries for unregistered cases"
+    )
+
+
+def test_memory_budget_for_unpinned_case_raises_with_fix():
+    with pytest.raises(KeyError, match="no pinned memory budget"):
+        memory_budget_for("not-a-registered-case")
+
+
+def test_engine_program_coverage_gate():
+    # Every program kind each engine can dispatch (CACHE_ARGNUM is the
+    # authoritative list — _dispatch donates by it) must map to at least
+    # one registered case, and every mapped case must exist. A new
+    # engine program kind fails here until it is registered and pinned.
+    import pytorch_distributed_tpu.serving.engine as engine_mod
+
+    cases = registered_cases()
+    for cls_name, kind_map in ENGINE_PROGRAM_CASES.items():
+        cls = getattr(engine_mod, cls_name)
+        kinds = set(cls.CACHE_ARGNUM)
+        assert kinds == set(kind_map), (
+            f"{cls_name}: CACHE_ARGNUM kinds {sorted(kinds)} != "
+            f"ENGINE_PROGRAM_CASES kinds {sorted(kind_map)} — register "
+            "and pin the new program before shipping it"
+        )
+        for kind, case_names in kind_map.items():
+            assert case_names, f"{cls_name}.{kind} maps to no cases"
+            for name in case_names:
+                assert name in cases, (
+                    f"{cls_name}.{kind} -> {name!r} is not registered"
+                )
+                assert name in STABLE_MEMORY_BUDGETS
+
+
+# --------------------------------------------------------------------------
+# 4. pool-ratio claims from static bytes + the injected-upcast negative
+# --------------------------------------------------------------------------
+
+
+def _paged_cfg(n_embd=64, n_head=4):
+    return ModelConfig(
+        vocab_size=128, n_ctx=16, n_embd=n_embd, n_layer=1, n_head=n_head,
+        dtype="float32", embd_pdrop=0.0, attn_pdrop=0.0, resid_pdrop=0.0,
+    )
+
+
+def _donated_pool_bytes(engine, kind="decode_step"):
+    """Donated-argument bytes of an engine program, derived from the
+    compiled HLO alone (entry-parameter shapes), not from the host
+    arrays — the whole point of the static path."""
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = engine.cfg
+    params = get_model(cfg).init(domain_key(42, "init"), cfg)
+    fn = engine.program(kind)
+    args = engine.example_args(kind, engine._place_params(params))
+    est = estimate_memory(fn.lower(*args).compile().as_text())
+    donated = donated_param_numbers(args, (engine.CACHE_ARGNUM[kind],))
+    assert donated - est.aliased_params == frozenset(), (
+        "engine donation must be fully aliased"
+    )
+    return est.param_bytes(donated), est
+
+
+@pytest.fixture(scope="module")
+def serving_engines():
+    from pytorch_distributed_tpu.serving.engine import (
+        BatchedDecodeEngine,
+        BucketSpec,
+        PagedBatchedDecodeEngine,
+    )
+
+    cfg = _paged_cfg()
+    dense = BatchedDecodeEngine(
+        cfg, slots=4, max_len=16, buckets=BucketSpec((8, 16))
+    )
+    paged_equal = PagedBatchedDecodeEngine(
+        cfg, slots=4, max_len=16, page_size=8, pool_pages=8,
+        prefill_chunk=8,
+    )
+    paged_small = PagedBatchedDecodeEngine(
+        cfg, slots=4, max_len=16, page_size=8, pool_pages=6,
+        prefill_chunk=8,
+    )
+    return dense, paged_equal, paged_small
+
+
+def test_paged_pool_never_exceeds_dense_at_equal_slots(serving_engines):
+    dense, paged_equal, paged_small = serving_engines
+    dense_bytes, _ = _donated_pool_bytes(dense)
+    equal_bytes, _ = _donated_pool_bytes(paged_equal)
+    small_bytes, _ = _donated_pool_bytes(paged_small)
+    # Equal capacity (pool_pages*page_size == slots*max_len): identical
+    # bytes — paging costs nothing. The win is allocating FEWER pages
+    # than worst-case slots*max_len: strictly smaller pool.
+    assert equal_bytes == dense_bytes
+    assert small_bytes < dense_bytes
+    assert small_bytes == dense_bytes * 6 * 8 // (4 * 16)
+
+
+@pytest.mark.parametrize("head_dim", [32])
+def test_int8_pool_ratio_from_static_bytes(head_dim):
+    # The committed 0.28x int8-pool claim, re-derived from HLO alone:
+    # at head_dim 32, (1 int8 byte + 4 scale bytes per head token) /
+    # (4 f32 bytes) = (32+4)/128 = 0.28125.
+    from pytorch_distributed_tpu.serving.engine import (
+        PagedBatchedDecodeEngine,
+    )
+
+    cfg = _paged_cfg(n_embd=head_dim * 4, n_head=4)
+    mk = lambda q: PagedBatchedDecodeEngine(  # noqa: E731
+        cfg, slots=4, max_len=16, page_size=8, pool_pages=8,
+        prefill_chunk=8, kv_quant=q,
+    )
+    f32_bytes, _ = _donated_pool_bytes(mk("none"))
+    q8_bytes, _ = _donated_pool_bytes(mk("int8"))
+    ratio = q8_bytes / f32_bytes
+    assert ratio == (head_dim + 4) / (4 * head_dim)
+    assert ratio == pytest.approx(0.28, abs=0.005)
+
+
+def test_f32_upcast_fails_the_int8_pool_contract(serving_engines):
+    # The injected-upcast negative: audit the FULL-PRECISION paged pool
+    # under the q8 case's pinned budget. The donated pool is ~4x the
+    # int8 contract and must fail donated-bytes-exceeded loudly — this
+    # is exactly what a kv_quant regression (engine silently built
+    # without int8 pages) would look like to the audit.
+    _, paged_equal, _ = serving_engines
+    pool_bytes, est = _donated_pool_bytes(paged_equal)
+    q8_budget = memory_budget_for("decode_paged_step_q8")
+    assert pool_bytes > q8_budget.max_donated_bytes
+    findings, stats = check_memory(
+        est, q8_budget,
+        donated_params=donated_param_numbers_for(paged_equal),
+    )
+    codes = [f.code for f in findings]
+    assert "donated-bytes-exceeded" in codes
+    [f] = [f for f in findings if f.code == "donated-bytes-exceeded"]
+    assert f.severity == "error"
+    assert stats["donated_bytes"] == pool_bytes
+
+
+def donated_param_numbers_for(engine, kind="decode_step"):
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    params = get_model(engine.cfg).init(domain_key(42, "init"), engine.cfg)
+    args = engine.example_args(kind, engine._place_params(params))
+    return donated_param_numbers(args, (engine.CACHE_ARGNUM[kind],))
