@@ -4,9 +4,12 @@ Audits any jitted step function's jaxpr + optimized HLO without running
 it: collective budgets per parallelism strategy, donation/aliasing,
 dtype leaks, recompilation/host-sync hazards, the vma
 replication/varying-axes checker for shard_map bodies (our own
-``check_vma``, independent of the jax version), and a static peak-HBM
+``check_vma``, independent of the jax version), a static peak-HBM
 liveness estimate diffed against pinned per-program byte budgets
-(analysis/memory.py + MemoryBudget). See docs/ANALYSIS.md.
+(analysis/memory.py + MemoryBudget), and a static
+FLOPs / HBM-traffic / wire-bytes cost estimate with a roofline step-time
+projection, diffed against pinned per-program throughput budgets
+(analysis/cost.py + CostBudget). See docs/ANALYSIS.md.
 
 Entry points:
 - ``audit_program(fn, args, budget) -> AuditReport`` — library API;
@@ -24,13 +27,25 @@ from pytorch_distributed_tpu.analysis.audit import (
 )
 from pytorch_distributed_tpu.analysis.budget import (
     NO_COLLECTIVES,
+    STABLE_COST_BUDGETS,
     STABLE_MEMORY_BUDGETS,
     CollectiveBudget,
+    CostBudget,
     MemoryBudget,
     check_budget,
+    check_cost,
     check_memory,
+    cost_budget_for,
     expected_budget,
     memory_budget_for,
+)
+from pytorch_distributed_tpu.analysis.cost import (
+    ProgramCost,
+    RooflineSpec,
+    V5E_ROOFLINE,
+    estimate_cost,
+    project_step_time,
+    projected_tok_s,
 )
 from pytorch_distributed_tpu.analysis.hlo import (
     HLO_COLLECTIVES,
@@ -59,15 +74,21 @@ from pytorch_distributed_tpu.analysis.vma_check import (
 __all__ = [
     "AuditReport",
     "CollectiveBudget",
+    "CostBudget",
     "Finding",
     "HLO_COLLECTIVES",
     "MemoryBudget",
     "MemoryEstimate",
     "NO_COLLECTIVES",
+    "ProgramCost",
+    "RooflineSpec",
+    "STABLE_COST_BUDGETS",
     "STABLE_MEMORY_BUDGETS",
+    "V5E_ROOFLINE",
     "VmaInterpreter",
     "audit_program",
     "check_budget",
+    "check_cost",
     "check_donation",
     "check_dtype",
     "check_hazards",
@@ -76,12 +97,16 @@ __all__ = [
     "check_vma_program",
     "collective_counts",
     "collective_instructions",
+    "cost_budget_for",
+    "estimate_cost",
     "estimate_memory",
     "expected_budget",
     "find_shard_map_eqns",
     "memory_budget_for",
     "parse_input_output_aliases",
     "parse_module",
+    "project_step_time",
+    "projected_tok_s",
     "reports_to_json",
     "shape_bytes",
 ]

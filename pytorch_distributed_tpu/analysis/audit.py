@@ -28,7 +28,14 @@ lowers + compiles it WITHOUT running it, and checks:
    donation check's intent-verification: every donated entry parameter
    that XLA did NOT alias is named (number, HLO name, shape, bytes), so
    a broken in-place cache contract fails as an error pointing at the
-   exact buffer that got double-buffered.
+   exact buffer that got double-buffered;
+7. cost — a static FLOPs / HBM-bytes-moved / collective-wire-bytes
+   estimate over the same optimized HLO (analysis/cost.py: contraction
+   math from dot shapes, dtype-aware traffic at fusion boundaries, ring
+   wire accounting from replica_groups, loop bodies multiplied by static
+   trip counts) diffed against the program's pinned ``CostBudget`` — the
+   throughput counterpart of check 6, so a doubled matmul, an upcast
+   page pool, or a fattened collective fails CI without hardware.
 
 The checkers are pure functions over the lowered artifacts, so everything
 runs on the CPU test rig (``JAX_PLATFORMS=cpu`` + virtual devices) against
@@ -41,9 +48,11 @@ import jax
 
 from pytorch_distributed_tpu.analysis.budget import (
     CollectiveBudget,
+    CostBudget,
     MemoryBudget,
     check_async_overlap,
     check_budget,
+    check_cost,
     check_memory,
 )
 from pytorch_distributed_tpu.analysis.hlo import (
@@ -56,7 +65,9 @@ from pytorch_distributed_tpu.analysis.report import AuditReport, Finding
 from pytorch_distributed_tpu.analysis.vma_check import check_vma_program
 from pytorch_distributed_tpu.profiling.trace_analysis import classify_op
 
-ALL_CHECKS = ("collectives", "donation", "dtype", "hazards", "vma", "memory")
+ALL_CHECKS = (
+    "collectives", "donation", "dtype", "hazards", "vma", "memory", "cost",
+)
 
 
 def _leaf_count(tree) -> int:
@@ -381,6 +392,7 @@ def audit_program(
     vma_allow: dict[str, str] | None = None,
     dtype_allow: dict[str, str] | None = None,
     memory_budget: MemoryBudget | None = None,
+    cost_budget: CostBudget | None = None,
 ) -> AuditReport:
     """Audit a jitted program's jaxpr + optimized HLO without running it.
 
@@ -409,6 +421,12 @@ def audit_program(
     ``memory_budget``: the program's pinned byte ceilings
     (budget.MemoryBudget / STABLE_MEMORY_BUDGETS); None still records the
     static estimate in summary["memory"] without judging it.
+    ``cost_budget``: the program's pinned FLOPs/HBM/wire ceilings
+    (budget.CostBudget / STABLE_COST_BUDGETS); None still records the
+    static cost in summary["cost"] without judging it. The roofline
+    projection recorded alongside treats the wire term as overlapped
+    exactly when the collective budget carries an ``async_min_compute``
+    contract.
     """
     unknown = set(checks) - set(ALL_CHECKS)
     if unknown:
@@ -427,6 +445,7 @@ def audit_program(
     need_hlo = (
         "collectives" in checks
         or "memory" in checks
+        or "cost" in checks
         or ("donation" in checks and expect_donation)
     )
     if need_hlo:
@@ -513,6 +532,45 @@ def audit_program(
             )
             report.extend(mem_findings)
             report.summary["memory"] = mem_stats
+
+    if "cost" in checks:
+        from pytorch_distributed_tpu.analysis.cost import (
+            estimate_cost,
+            project_step_time,
+        )
+
+        try:
+            cost = estimate_cost(hlo_text)
+        except Exception as e:
+            # An error, not a warn: a crashed estimator means the
+            # program's throughput ceilings are UNVERIFIED, and the cost
+            # CI gate must not report it green.
+            report.findings.append(
+                Finding(
+                    checker="cost",
+                    code="cost-estimate-failed",
+                    severity="error",
+                    message=(
+                        f"static cost estimator crashed on this program "
+                        f"({e!r}) — its FLOPs/HBM/wire budgets are "
+                        "UNVERIFIED"
+                    ),
+                )
+            )
+        else:
+            cost_findings, cost_stats = check_cost(cost, cost_budget)
+            report.extend(cost_findings)
+            # Roofline projection at the default chip spec, wire term
+            # overlapped only when the program carries a machine-checked
+            # overlap contract (CollectiveBudget.async_min_compute).
+            cost_stats["roofline"] = project_step_time(
+                cost,
+                overlapped_comm=(
+                    budget is not None
+                    and budget.async_min_compute is not None
+                ),
+            )
+            report.summary["cost"] = cost_stats
 
     jaxpr = None
     summary = None

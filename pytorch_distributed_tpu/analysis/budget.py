@@ -151,11 +151,17 @@ class MemoryBudget:
     size — the quantized-pool contract (an int8 page pool silently upcast
     to f32 is ~4x these bytes and must fail loudly, independent of what
     the rest of the program does).
+    ``max_loop_body_peak_bytes``: optional ceiling on the largest while-body
+    liveness peak — the steady-state-HBM contract for decode loops, where
+    the token loop's per-iteration footprint (not the one-shot entry
+    setup) is what an accelerator actually holds for the life of a
+    generation. Pinned for the serving decode cases.
     """
 
     max_live_bytes: int | None = None
     max_unaliased_donated_bytes: int = 0
     max_donated_bytes: int | None = None
+    max_loop_body_peak_bytes: int | None = None
     note: str = ""
 
 
@@ -202,6 +208,7 @@ def check_memory(
         "max_live_bytes": budget.max_live_bytes,
         "max_unaliased_donated_bytes": budget.max_unaliased_donated_bytes,
         "max_donated_bytes": budget.max_donated_bytes,
+        "max_loop_body_peak_bytes": budget.max_loop_body_peak_bytes,
         "note": budget.note,
     }
 
@@ -269,6 +276,31 @@ def check_memory(
                 ),
                 detail={"params": unaliased[:16],
                         "bytes": unaliased_bytes},
+            )
+        )
+    if (
+        budget.max_loop_body_peak_bytes is not None
+        and stats["loop_body_peak_bytes"] > budget.max_loop_body_peak_bytes
+    ):
+        findings.append(
+            Finding(
+                checker="memory",
+                code="loop-body-peak-exceeded",
+                severity="error",
+                message=(
+                    f"largest while-body liveness peak "
+                    f"{stats['loop_body_peak_bytes']:,} bytes > pinned "
+                    f"ceiling {budget.max_loop_body_peak_bytes:,} — the "
+                    "steady-state decode-loop footprint grew (a "
+                    "per-iteration buffer stopped aliasing or a setup "
+                    "tensor moved inside the token loop)"
+                ),
+                detail={
+                    "loop_body_peak_bytes": stats["loop_body_peak_bytes"],
+                    "max_loop_body_peak_bytes":
+                        budget.max_loop_body_peak_bytes,
+                    "loop_bodies": loop_peaks,
+                },
             )
         )
     if (
@@ -340,58 +372,75 @@ STABLE_MEMORY_BUDGETS: dict[str, MemoryBudget] = {
     ),
     "decode_prefill": MemoryBudget(
         max_live_bytes=554_156, max_donated_bytes=16_384,
+        max_loop_body_peak_bytes=290_956,
     ),
     "decode_step": MemoryBudget(
         max_live_bytes=486_972, max_donated_bytes=16_384,
+        max_loop_body_peak_bytes=223_776,
     ),
     "zero3_decode_prefetch": MemoryBudget(
         max_live_bytes=299_766, max_donated_bytes=16_384,
+        max_loop_body_peak_bytes=242_286,
     ),
     "decode_batched_prefill": MemoryBudget(
         max_live_bytes=619_697, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=290_956,
     ),
     "decode_batched_step": MemoryBudget(
         max_live_bytes=672_000, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=408_724,
     ),
     "decode_batched_step_tp": MemoryBudget(
         max_live_bytes=197_760, max_donated_bytes=16_384,
+        max_loop_body_peak_bytes=106_516,
     ),
     "decode_paged_prefill": MemoryBudget(
         max_live_bytes=681_213, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=417_956,
     ),
     "decode_paged_step": MemoryBudget(
         max_live_bytes=672_000, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=408_724,
     ),
     "decode_paged_prefill_q8": MemoryBudget(
         max_live_bytes=275_461, max_donated_bytes=20_480,
+        max_loop_body_peak_bytes=196_668,
         note="int8 pool + per-token scales: 0.3125x the f32 pool at "
              "head_dim 16; an f32 upcast fails donated-bytes-exceeded",
     ),
     "decode_paged_step_q8": MemoryBudget(
         max_live_bytes=267_656, max_donated_bytes=20_480,
+        max_loop_body_peak_bytes=188_828,
         note="int8 pool + per-token scales: 0.3125x the f32 pool at "
              "head_dim 16; an f32 upcast fails donated-bytes-exceeded",
     ),
     "decode_batched_step_tp_q8": MemoryBudget(
         max_live_bytes=125_952, max_donated_bytes=16_384,
+        max_loop_body_peak_bytes=69_524,
     ),
     "decode_batched_spec_step": MemoryBudget(
         max_live_bytes=699_984, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=436_628,
     ),
     "decode_paged_spec_step": MemoryBudget(
         max_live_bytes=700_016, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=436_564,
     ),
     "decode_batched_step_tp_spec": MemoryBudget(
         max_live_bytes=211_920, max_donated_bytes=16_384,
+        max_loop_body_peak_bytes=120_596,
     ),
     "decode_paged_prefill_lora": MemoryBudget(
         max_live_bytes=705_794, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=436_524,
     ),
     "decode_paged_step_lora": MemoryBudget(
         max_live_bytes=696_612, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=427_328,
     ),
     "decode_batched_step_tp_lora": MemoryBudget(
         max_live_bytes=213_156, max_donated_bytes=16_384,
+        max_loop_body_peak_bytes=115_736,
     ),
     "ddp_pjit": MemoryBudget(max_live_bytes=2_458_808),
     "fsdp_pjit": MemoryBudget(max_live_bytes=1_094_776),
@@ -418,6 +467,322 @@ def memory_budget_for(case: str) -> MemoryBudget:
             f"{case} --only memory --json r.json, read "
             "summary.memory) and add a STABLE_MEMORY_BUDGETS entry "
             "(docs/ANALYSIS.md §6 documents the re-pin procedure)"
+        ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class CostBudget:
+    """Pinned per-step throughput-resource ceilings for one program.
+
+    The three quantities analysis/cost.py derives statically from the
+    scheduled HLO — FLOPs executed, HBM bytes moved, collective wire
+    bytes — frozen per registered case in ``STABLE_COST_BUDGETS`` the
+    way STABLE_MEMORY_BUDGETS freezes peak-live bytes. Exceeding any
+    ceiling is a perf regression (a doubled matmul, an upcast page
+    pool, an un-coalesced collective) until adjudicated and re-pinned;
+    shrinkage always passes. ``allow_lower_bound`` acknowledges a
+    program whose cost is a loud lower bound (an unknown-trip-count
+    while); pinned programs default to refusing that, so a scheduling
+    change that hides a loop's trip count cannot quietly deflate its
+    pinned numbers.
+    """
+
+    max_flops: int | None = None
+    max_hbm_bytes: int | None = None
+    max_wire_bytes: int | None = None
+    allow_lower_bound: bool = False
+    note: str = ""
+
+
+def check_cost(cost, budget: CostBudget | None) -> tuple[list[Finding], dict]:
+    """Diff a program's static cost estimate against its pinned budget.
+
+    ``cost``: analysis/cost.estimate_cost over the compiled module text.
+    Returns (findings, stats); a None budget records stats without
+    judging them (scripts/audit.py still prints them).
+    """
+    stats = {
+        "flops": cost.flops,
+        "hbm_bytes": cost.hbm_bytes,
+        "wire_bytes": cost.wire_bytes,
+        "wire_by_collective": dict(cost.wire_by_collective),
+        "arithmetic_intensity": round(cost.arithmetic_intensity, 4),
+        "lower_bound": cost.lower_bound,
+        "unknown_trip_whiles": list(cost.unknown_trip_whiles),
+        "num_partitions": cost.num_partitions,
+    }
+    findings: list[Finding] = []
+    if budget is None:
+        return findings, stats
+    stats["budget"] = {
+        "max_flops": budget.max_flops,
+        "max_hbm_bytes": budget.max_hbm_bytes,
+        "max_wire_bytes": budget.max_wire_bytes,
+        "note": budget.note,
+    }
+    if cost.lower_bound and not budget.allow_lower_bound:
+        findings.append(
+            Finding(
+                checker="cost",
+                code="cost-lower-bound",
+                severity="error",
+                message=(
+                    "cost estimate is only a LOWER BOUND: while loop(s) "
+                    f"{list(cost.unknown_trip_whiles)} carry no static "
+                    "trip count, so their bodies were counted once — the "
+                    "pinned ceilings cannot certify this program; derive "
+                    "the trip count or set allow_lower_bound with "
+                    "reasoning"
+                ),
+                detail={"whiles": list(cost.unknown_trip_whiles)},
+            )
+        )
+    for label, got, cap in (
+        ("flops", cost.flops, budget.max_flops),
+        ("hbm_bytes", cost.hbm_bytes, budget.max_hbm_bytes),
+        ("wire_bytes", cost.wire_bytes, budget.max_wire_bytes),
+    ):
+        if cap is not None and got > cap:
+            findings.append(
+                Finding(
+                    checker="cost",
+                    code=f"cost-{label.replace('_', '-')}-exceeded",
+                    severity="error",
+                    message=(
+                        f"static {label} {got:,} > pinned ceiling "
+                        f"{cap:,} — the per-step {label} grew (doubled "
+                        "math, upcast traffic, or an extra collective); "
+                        "re-pin only if the growth is a deliberate "
+                        "contract change (docs/ANALYSIS.md §7)"
+                    ),
+                    detail={label: got, f"max_{label}": cap},
+                )
+            )
+    return findings, stats
+
+
+# Pinned static-cost ceilings per registered audit case — the
+# throughput counterpart of STABLE_MEMORY_BUDGETS. Each triple is the
+# measured per-chip FLOPs / HBM-bytes-moved / collective-wire-bytes of
+# the compiled program on the tiny registry models (8 virtual CPU
+# devices, XLA:CPU schedule, jax 0.4.37), frozen exactly: growth in any
+# number is a perf regression (doubled math, upcast traffic, extra or
+# fatter collectives) until adjudicated and re-pinned; shrinkage always
+# passes. The relationships BETWEEN pins are themselves claims the test
+# suite re-derives from cost alone (tests/test_cost_analysis.py):
+# - the q8 decode steps move FEWER HBM bytes than their f32 twins
+#   (1_935_015 < 3_411_430: int8 pages are real traffic, not just a
+#   smaller allocation);
+# - zero2_bucketed's wire bytes EQUAL zero2's (1_147_790 both —
+#   bucketing coalesces instructions, the gradient bytes on the wire
+#   are conserved);
+# - the speculative [slots, K+1] verify steps cost ~(K+1)x the plain
+#   step's FLOPs (3_788_766 / 995_578 ≈ 3.8 at K=3: verification is
+#   K+1 tokens of real work in one dispatch, not free);
+# - the ddp/zero1/zero2/zero3 wire bytes match profiling/comm_model's
+#   analytic ring formulas (ddp: 2·G·(N-1)/N = 765_191 at G≈437 KiB,
+#   N=8).
+# Wire pins are per-chip ring-transfer bytes; 0 means every collective
+# in the program (if any) spans a single-member group.
+# Re-pin procedure: docs/ANALYSIS.md §7.
+STABLE_COST_BUDGETS: dict[str, CostBudget] = {
+    "baseline": CostBudget(
+        max_flops=183_932_936, max_hbm_bytes=169_741_764,
+        max_wire_bytes=0,
+    ),
+    "train_guard": CostBudget(
+        max_flops=185_035_563, max_hbm_bytes=171_955_291,
+        max_wire_bytes=0,
+    ),
+    "ddp": CostBudget(
+        max_flops=24_937_385, max_hbm_bytes=23_071_428,
+        max_wire_bytes=765_191,
+    ),
+    "ddp_bf16": CostBudget(
+        max_flops=25_543_593, max_hbm_bytes=24_730_316,
+        max_wire_bytes=765_191,
+        note="wire bytes EQUAL f32 ddp's: grads are reduced in f32 "
+             "(master-weight contract) even under bf16 compute",
+    ),
+    "fsdp": CostBudget(
+        max_flops=23_024_363, max_hbm_bytes=15_904_440,
+        max_wire_bytes=1_114_638,
+    ),
+    "zero2": CostBudget(
+        max_flops=23_024_443, max_hbm_bytes=21_446_912,
+        max_wire_bytes=1_147_790,
+    ),
+    "fsdp_prefetch": CostBudget(
+        max_flops=23_504_197, max_hbm_bytes=14_366_932,
+        max_wire_bytes=1_114_862,
+        note="wire ~= plain fsdp (224 B of window bookkeeping): the "
+             "prefetch schedule moves WHEN gathers run, not how much",
+    ),
+    "zero2_bucketed": CostBudget(
+        max_flops=23_024_550, max_hbm_bytes=22_520_684,
+        max_wire_bytes=1_147_790,
+        note="wire bytes EQUAL zero2's: bucketing coalesces 16 "
+             "reduce-scatters into 2, the gradient bytes are conserved",
+    ),
+    "tp": CostBudget(
+        max_flops=58_934_440, max_hbm_bytes=138_191_808,
+        max_wire_bytes=983_046,
+    ),
+    "ring": CostBudget(
+        max_flops=48_101_694, max_hbm_bytes=59_218_736,
+        max_wire_bytes=1_245_702,
+    ),
+    "ulysses": CostBudget(
+        max_flops=48_547_534, max_hbm_bytes=41_490_092,
+        max_wire_bytes=950_790,
+    ),
+    "ep": CostBudget(
+        max_flops=275_422_141, max_hbm_bytes=85_402_756,
+        max_wire_bytes=1_441_548,
+    ),
+    "pipeline": CostBudget(
+        max_flops=123_603_517, max_hbm_bytes=125_967_090,
+        max_wire_bytes=201_228,
+    ),
+    "pipeline_1f1b": CostBudget(
+        max_flops=312_516_369, max_hbm_bytes=205_151_114,
+        max_wire_bytes=365_064,
+    ),
+    "decode_prefill": CostBudget(
+        max_flops=1_870_946, max_hbm_bytes=2_286_998,
+        max_wire_bytes=0,
+    ),
+    "decode_step": CostBudget(
+        max_flops=248_741, max_hbm_bytes=1_245_366,
+        max_wire_bytes=0,
+    ),
+    "zero3_decode_prefetch": CostBudget(
+        max_flops=160_202, max_hbm_bytes=1_588_952,
+        max_wire_bytes=351_750,
+        allow_lower_bound=True,
+        note="decode_run's token while exits early on EOS — the trip "
+             "count is data-dependent, so XLA records none and the "
+             "body is counted ONCE; the pin certifies the per-iteration "
+             "cost shape (setup + one token step), not a full "
+             "generation",
+    ),
+    "decode_batched_prefill": CostBudget(
+        max_flops=1_875_603, max_hbm_bytes=2_487_782,
+        max_wire_bytes=0,
+    ),
+    "decode_batched_step": CostBudget(
+        max_flops=995_438, max_hbm_bytes=3_412_262,
+        max_wire_bytes=0,
+    ),
+    "decode_batched_step_tp": CostBudget(
+        max_flops=357_974, max_hbm_bytes=1_047_718,
+        max_wire_bytes=6_144,
+    ),
+    "decode_paged_prefill": CostBudget(
+        max_flops=1_874_550, max_hbm_bytes=3_968_747,
+        max_wire_bytes=0,
+    ),
+    "decode_paged_step": CostBudget(
+        max_flops=995_578, max_hbm_bytes=3_411_430,
+        max_wire_bytes=0,
+    ),
+    "decode_paged_prefill_q8": CostBudget(
+        max_flops=1_918_006, max_hbm_bytes=2_106_172,
+        max_wire_bytes=0,
+        note="HBM 0.53x the f32 paged prefill: int8 pages move int8 "
+             "bytes; the extra flops are the quantize/dequantize math",
+    ),
+    "decode_paged_step_q8": CostBudget(
+        max_flops=1_031_642, max_hbm_bytes=1_935_015,
+        max_wire_bytes=0,
+        note="HBM 0.57x the f32 paged step: the cache-read traffic "
+             "shrinks by the page pool's 0.3125x, diluted by the "
+             "unquantized weights/activations",
+    ),
+    "decode_batched_step_tp_q8": CostBudget(
+        max_flops=360_662, max_hbm_bytes=913_846,
+        max_wire_bytes=6_144,
+        note="wire bytes EQUAL the f32 tp step's: the Megatron psums "
+             "reduce f32 activations either way; int8 slims HBM, not "
+             "the wire",
+    ),
+    "decode_batched_spec_step": CostBudget(
+        max_flops=3_788_230, max_hbm_bytes=5_724_974,
+        max_wire_bytes=0,
+    ),
+    "decode_paged_spec_step": CostBudget(
+        max_flops=3_788_766, max_hbm_bytes=5_725_198,
+        max_wire_bytes=0,
+        note="~3.8x the plain paged step's flops at K=3: the [slots, "
+             "K+1] verify forward is K+1 tokens of real math in one "
+             "dispatch",
+    ),
+    "decode_batched_step_tp_spec": CostBudget(
+        max_flops=1_238_374, max_hbm_bytes=1_814_062,
+        max_wire_bytes=24_576,
+        note="wire = 4x the plain tp step's 6_144: the psum payload is "
+             "[slots, K+1, ...] — speculative verify widens the "
+             "collective by exactly K+1",
+    ),
+    "decode_paged_prefill_lora": CostBudget(
+        max_flops=1_977_084, max_hbm_bytes=4_128_576,
+        max_wire_bytes=0,
+    ),
+    "decode_paged_step_lora": CostBudget(
+        max_flops=1_046_878, max_hbm_bytes=3_540_842,
+        max_wire_bytes=0,
+    ),
+    "decode_batched_step_tp_lora": CostBudget(
+        max_flops=390_074, max_hbm_bytes=1_133_610,
+        max_wire_bytes=6_144,
+    ),
+    "ddp_pjit": CostBudget(
+        max_flops=24_735_275, max_hbm_bytes=23_540_208,
+        max_wire_bytes=822_535,
+    ),
+    "fsdp_pjit": CostBudget(
+        max_flops=23_073_182, max_hbm_bytes=29_725_492,
+        max_wire_bytes=3_567_767,
+        note="3.2x the explicit fsdp's wire: GSPMD re-gathers per use "
+             "site where the explicit schedule gathers once per layer "
+             "— the quantified cost of leaving placement to the "
+             "partitioner",
+    ),
+    "zero2_pjit": CostBudget(
+        max_flops=23_108_497, max_hbm_bytes=33_532_884,
+        max_wire_bytes=2_770_551,
+    ),
+    "tp_pjit": CostBudget(
+        max_flops=58_901_672, max_hbm_bytes=137_143_324,
+        max_wire_bytes=786_468,
+    ),
+    "ring_pjit": CostBudget(
+        max_flops=47_477_847, max_hbm_bytes=39_873_764,
+        max_wire_bytes=1_445_382,
+    ),
+    "ep_pjit": CostBudget(
+        max_flops=402_948_676, max_hbm_bytes=104_013_404,
+        max_wire_bytes=3_281_922,
+    ),
+}
+
+
+def cost_budget_for(case: str) -> CostBudget:
+    """The pinned STABLE_COST_BUDGETS entry for ``case``.
+
+    KeyError (with the fix spelled out) when the case has no pin: every
+    registered program must carry a cost budget, so a new program cannot
+    ship with unaudited throughput resources.
+    """
+    try:
+        return STABLE_COST_BUDGETS[case]
+    except KeyError:
+        raise KeyError(
+            f"no pinned cost budget for registered case {case!r} — "
+            "measure it (scripts/audit.py --case "
+            f"{case} --only cost --json r.json, read "
+            "summary.cost) and add a STABLE_COST_BUDGETS entry "
+            "(docs/ANALYSIS.md §7 documents the re-pin procedure)"
         ) from None
 
 

@@ -133,6 +133,38 @@ def shape_bytes(shape: str) -> int:
     return math.ceil(n * bits / 8)
 
 
+def shape_dims(shape: str) -> tuple[int, ...] | None:
+    """Dimension sizes of one ARRAY shape string (``f32[4,16]{1,0}`` ->
+    (4, 16); scalars -> ()); None for tuples/unparseable shapes."""
+    shape = shape.strip()
+    if shape.startswith("("):
+        return None
+    m = _ARRAY_SHAPE_RE.match(shape)
+    if not m:
+        return None
+    return tuple(
+        int(d) for d in m.group(2).split(",") if d.strip()
+    )
+
+
+def shape_elements(shape: str) -> int:
+    """Element count of one HLO shape string (tuples sum their
+    components; token/opaque count zero)."""
+    shape = shape.strip()
+    if shape.startswith("("):
+        return sum(shape_elements(part) for part in _split_tuple(shape))
+    m = _ARRAY_SHAPE_RE.match(shape)
+    if not m:
+        return 0
+    if _DTYPE_BITS.get(m.group(1), 0) == 0:
+        return 0
+    n = 1
+    for d in m.group(2).split(","):
+        if d.strip():
+            n *= int(d)
+    return n
+
+
 def _split_tuple(shape: str) -> list[str]:
     """Top-level components of ``(a, b, (c, d))`` (paren-aware)."""
     body = shape.strip()[1:-1]
@@ -200,6 +232,14 @@ class HloInstruction:
     called: tuple[str, ...]  # computations referenced via attrs
     is_root: bool
     param_number: int | None  # for opcode == "parameter"
+    # Inline operand type strings, positionally aligned with ``operands``
+    # ("" where the dump omitted the type) — the cost model reads
+    # contraction/operand sizes straight off the line without an
+    # instruction-table lookup.
+    operand_shapes: tuple[str, ...] = ()
+    # Raw attribute text after the operand list (contracting dims,
+    # replica_groups, backend_config with known_trip_count, ...).
+    attrs: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -226,6 +266,40 @@ class HloModule:
     roles: dict[str, str]
 
 
+_BLOCK_COMMENT_RE = re.compile(r"/\*.*?\*/")
+
+
+def _parse_operand_list(body: str) -> tuple[tuple[str, ...], tuple[str, ...]]:
+    """(names, inline type strings) of an operand body, split at top-level
+    commas. Dumps interleave types with %-names (``dot(f32[32,64]{1,0}
+    %a, ...)``) and may inject ``/*index=N*/`` comments; an operand whose
+    type the dump omitted gets an empty shape string."""
+    body = _BLOCK_COMMENT_RE.sub("", body)
+    names: list[str] = []
+    shapes: list[str] = []
+    depth, start = 0, 0
+    parts: list[str] = []
+    for i, ch in enumerate(body):
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(body[start:i])
+            start = i + 1
+    if body[start:].strip():
+        parts.append(body[start:])
+    for part in parts:
+        part = part.strip()
+        nm = _OPERAND_NAME_RE.search(part)
+        if not nm:
+            continue
+        names.append(nm.group(1))
+        scanned = _scan_shape(part)
+        shapes.append(scanned[0] if scanned else "")
+    return tuple(names), tuple(shapes)
+
+
 def _parse_instruction(line: str) -> HloInstruction | None:
     m = _INSTR_LINE_RE.match(line)
     if not m:
@@ -244,6 +318,7 @@ def _parse_instruction(line: str) -> HloInstruction | None:
     rest = rest[om.end():]
     # Operand body: balanced parens right after the opcode. Attrs follow.
     operands: tuple[str, ...] = ()
+    operand_shapes: tuple[str, ...] = ()
     param_number = None
     attrs = rest
     if rest.startswith("("):
@@ -257,7 +332,7 @@ def _parse_instruction(line: str) -> HloInstruction | None:
                     end = i + 1
                     break
         body, attrs = rest[1:end - 1], rest[end:]
-        operands = tuple(_OPERAND_NAME_RE.findall(body))
+        operands, operand_shapes = _parse_operand_list(body)
         if opcode == "parameter":
             try:
                 param_number = int(body.strip())
@@ -267,7 +342,8 @@ def _parse_instruction(line: str) -> HloInstruction | None:
     return HloInstruction(
         name=name, shape=shape, bytes=shape_bytes(shape), opcode=opcode,
         operands=operands, called=called, is_root=is_root,
-        param_number=param_number,
+        param_number=param_number, operand_shapes=operand_shapes,
+        attrs=attrs,
     )
 
 

@@ -26,6 +26,7 @@ from pytorch_distributed_tpu.analysis.budget import (
     NO_COLLECTIVES,
     STABLE_MAX_COUNTS,
     CollectiveBudget,
+    cost_budget_for,
     expected_budget,
     memory_budget_for,
     pin_max_counts,
@@ -840,25 +841,29 @@ def registered_cases() -> dict[str, AuditCase]:
     ]
     return {
         c.name: dataclasses.replace(
-            c, build=_with_memory_budget(c.name, c.build)
+            c, build=_with_pinned_budgets(c.name, c.build)
         )
         for c in cases
     }
 
 
-def _with_memory_budget(name: str, build: Callable[[], tuple]):
-    """Attach the case's pinned MemoryBudget at build time.
+def _with_pinned_budgets(name: str, build: Callable[[], tuple]):
+    """Attach the case's pinned MemoryBudget AND CostBudget at build time.
 
-    Every registered program carries its STABLE_MEMORY_BUDGETS pin the
-    way the collective cases carry STABLE_MAX_COUNTS — and
-    memory_budget_for raises on a missing pin, so registering a new case
-    without measuring its bytes fails the audit instead of shipping an
+    Every registered program carries its STABLE_MEMORY_BUDGETS and
+    STABLE_COST_BUDGETS pins the way the collective cases carry
+    STABLE_MAX_COUNTS — and both ``*_budget_for`` lookups raise on a
+    missing pin, so registering a new case without measuring its bytes
+    and its FLOPs/traffic fails the audit instead of shipping an
     unpinned program. A case can still override by putting its own
-    ``memory_budget`` in audit_kwargs (none do today)."""
+    ``memory_budget``/``cost_budget`` in audit_kwargs (none do today)."""
 
     def wrapped():
         fn, args, budget, audit_kwargs = build()
-        audit_kwargs.setdefault("memory_budget", memory_budget_for(name))
+        if "memory_budget" not in audit_kwargs:
+            audit_kwargs["memory_budget"] = memory_budget_for(name)
+        if "cost_budget" not in audit_kwargs:
+            audit_kwargs["cost_budget"] = cost_budget_for(name)
         return fn, args, budget, audit_kwargs
 
     return wrapped

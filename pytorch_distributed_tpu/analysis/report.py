@@ -103,6 +103,20 @@ class AuditReport:
                     _fmt_bytes(mem.get("unaliased_donated_bytes", 0)),
                 )
             )
+        cost = self.summary.get("cost")
+        if cost is not None:
+            roof = cost.get("roofline") or {}
+            lines.append(
+                "  cost:        {:,} flops, {} moved, {} on wire "
+                "(AI {:.2f}, {}-bound{})".format(
+                    cost.get("flops", 0),
+                    _fmt_bytes(cost.get("hbm_bytes", 0)),
+                    _fmt_bytes(cost.get("wire_bytes", 0)),
+                    cost.get("arithmetic_intensity", 0.0),
+                    roof.get("bound", "?"),
+                    ", LOWER BOUND" if cost.get("lower_bound") else "",
+                )
+            )
         dots = self.summary.get("dot_dtypes")
         if dots:
             pretty = ", ".join(f"{k}x{v}" for k, v in sorted(dots.items()))

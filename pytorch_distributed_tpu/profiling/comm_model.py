@@ -85,6 +85,58 @@ def ddp_comm_bytes_per_step(
     return {"all_reduce": ar, "total": ar}
 
 
+def zero1_comm_bytes_per_step(
+    n_params: int,
+    n_chips: int,
+    *,
+    param_bytes: int = 4,
+    grad_bytes: int = 4,
+) -> dict:
+    """Per-chip traffic of one ZeRO-1 (shard_opt) step, mirroring
+    parallel/explicit.py's structure: grads replicated-all-reduced like
+    DDP (2 * G * (N-1)/N), then the sharded optimizer's updated param
+    shards re-materialise via a psum of disjoint padded slices —
+    numerically an all_gather, emitted as an all-reduce
+    (2 * P * (N-1)/N)."""
+    if n_chips < 2:
+        return {"grad_all_reduce": 0.0, "param_all_reduce": 0.0,
+                "total": 0.0}
+    frac = (n_chips - 1) / n_chips
+    g_ar = 2.0 * n_params * grad_bytes * frac
+    p_ar = 2.0 * n_params * param_bytes * frac
+    return {
+        "grad_all_reduce": g_ar,
+        "param_all_reduce": p_ar,
+        "total": g_ar + p_ar,
+    }
+
+
+def zero2_comm_bytes_per_step(
+    n_params: int,
+    n_chips: int,
+    *,
+    param_bytes: int = 4,
+    grad_bytes: int = 4,
+) -> dict:
+    """Per-chip traffic of one ZeRO-2 (shard_grad_op) step, mirroring
+    parallel/explicit.py: grads reduce-scattered onto the optimizer
+    shards (G * (N-1)/N) and the updated params re-materialised via the
+    same disjoint-slice psum as ZeRO-1 (an all-reduce,
+    2 * P * (N-1)/N). Bucketing the reduce-scatter (rs_buckets) changes
+    the instruction count, never these bytes."""
+    if n_chips < 2:
+        return {"reduce_scatter": 0.0, "param_all_reduce": 0.0,
+                "total": 0.0}
+    frac = (n_chips - 1) / n_chips
+    rs = float(n_params) * grad_bytes * frac
+    p_ar = 2.0 * n_params * param_bytes * frac
+    return {
+        "reduce_scatter": rs,
+        "param_all_reduce": p_ar,
+        "total": rs + p_ar,
+    }
+
+
 def zero_memory_per_chip(
     n_params: int,
     n_chips: int,

@@ -256,6 +256,55 @@ def _serving_cfg(dryrun: bool):
     )
 
 
+def _roofline_projection(engine, params, *, kind="decode_step",
+                         tokens_per_step=1):
+    """Static roofline projection for one engine decode program, placed
+    next to the measured tok/s in the serving JSON so projection drift
+    is visible in committed artifacts.
+
+    The projection is ``analysis.cost`` over the scheduled HLO at the
+    pinned chip specs (``V5E_ROOFLINE``) — the measured numbers in the
+    same row come from whatever rig ran the bench (usually the CPU
+    test rig), so the two are NOT expected to agree in magnitude; the
+    projection is the chip-side ceiling the schedule implies. Never
+    fails a leg: any error is reported in-row instead of raising, so
+    measured numbers still publish."""
+    from pytorch_distributed_tpu.analysis.cost import (
+        V5E_ROOFLINE,
+        estimate_cost,
+        project_step_time,
+        projected_tok_s,
+    )
+
+    try:
+        placed = engine._place_params(params)
+        try:
+            fn = engine.program(kind)
+            ex = engine.example_args(kind, placed)
+        except TypeError:
+            # Serial DecodeEngine: program(kind, sampled) and
+            # sampled-flagged example args — project the greedy path.
+            fn = engine.program(kind, False)
+            ex = engine.example_args(kind, placed, sampled=False)
+        cost = estimate_cost(fn.lower(*ex).compile().as_text())
+        proj = project_step_time(cost)
+        return {
+            "spec": V5E_ROOFLINE.name,
+            "kind": kind,
+            "tokens_per_step": tokens_per_step,
+            "projected_tok_s": round(
+                projected_tok_s(cost, tokens_per_step), 1
+            ),
+            "projected_step_us": round(proj["projected_step_s"] * 1e6, 3),
+            "bound": proj["bound"],
+            "arithmetic_intensity": round(cost.arithmetic_intensity, 2),
+            "lower_bound": cost.lower_bound,
+        }
+    except Exception as exc:  # noqa: BLE001 — bench rows must publish
+        return {"spec": V5E_ROOFLINE.name, "kind": kind,
+                "error": f"{type(exc).__name__}: {exc}"}
+
+
 def bench_serving(args) -> list[dict]:
     import jax
     import numpy as np
@@ -424,6 +473,9 @@ def bench_serving(args) -> list[dict]:
     engine_row["cache_hbm_bytes"] = engine.cache_hbm_bytes()["allocated"]
     engine_row["cache_hbm_bytes_peak_in_use"] = (
         engine.cache_hbm_bytes()["peak_in_use"]
+    )
+    engine_row["roofline"] = _roofline_projection(
+        engine, params, tokens_per_step=1
     )
     rows.append({
         "leg": "serving_stream",
@@ -736,6 +788,9 @@ def bench_serving_batched(args) -> list[dict]:
             _leg(batched_span, batched_lat.values(),
                  batched_steady_compiles),
             cache_hbm_bytes=batched.cache_hbm_bytes()["allocated"],
+            roofline=_roofline_projection(
+                batched, params, tokens_per_step=slots
+            ),
         ),
         "aggregate_speedup": round(serial_span / batched_span, 3),
         "platform": jax.devices()[0].platform,
@@ -882,6 +937,9 @@ def bench_serving_paged(args) -> list[dict]:
             "observed_compile_count_steady": steady,
             "cache_hbm_bytes": hbm["allocated"],
             "cache_hbm_bytes_peak_in_use": hbm["peak_in_use"],
+            "roofline": _roofline_projection(
+                eng, params, tokens_per_step=eng.slots
+            ),
         }
 
     pool_stats = paged.pool.stats
@@ -1164,6 +1222,10 @@ def bench_serving_quant(args) -> list[dict]:
             "cache_hbm_bytes": hbm[name]["allocated"],
             "cache_hbm_bytes_peak_in_use": hbm[name]["peak_in_use"],
             "preemptions": engines[name].counters["preemptions"],
+            "roofline": _roofline_projection(
+                engines[name], params,
+                tokens_per_step=engines[name].slots,
+            ),
         }
 
     row = {
@@ -1375,8 +1437,22 @@ def bench_serving_spec(args) -> list[dict]:
             "page_size": page, "prefill_chunk": chunk,
             "pool_pages": pool_pages, "requests": len(requests),
             "speculative_k": spec_k, "spec_ngram": ngram, "seed": seed,
-            "plain": leg(p_span, p_lat, steady_p),
-            "speculative": leg(s_span, s_lat, steady_s),
+            "plain": dict(
+                leg(p_span, p_lat, steady_p),
+                roofline=_roofline_projection(
+                    plain, params, tokens_per_step=slots
+                ),
+            ),
+            "speculative": dict(
+                leg(s_span, s_lat, steady_s),
+                # tokens_per_step=slots is the zero-accept FLOOR for a
+                # verify step (>=1 committed token per row); measured
+                # accept rates raise the real rate above it.
+                roofline=_roofline_projection(
+                    spec, params, kind="decode_spec_step",
+                    tokens_per_step=slots,
+                ),
+            ),
             "spec_extras": {
                 "drafted_tokens": c["drafted_tokens"],
                 "accepted_tokens": c["accepted_tokens"],
@@ -1468,6 +1544,15 @@ def bench_serving_spec(args) -> list[dict]:
             "spec_accept_rate": tp_spec.stats()["spec_accept_rate"],
             "outputs_match": f"{tp_matched}/{tp_n}",
             "observed_compile_count_steady": tp_steady,
+            "roofline": {
+                "plain": _roofline_projection(
+                    tp_plain, params, tokens_per_step=2
+                ),
+                "speculative": _roofline_projection(
+                    tp_spec, params, kind="decode_spec_step",
+                    tokens_per_step=2,
+                ),
+            },
             "platform": jax.devices()[0].platform,
         })
 

@@ -1,0 +1,587 @@
+"""Static cost estimator + CostBudget contract (analysis/cost).
+
+Four layers, mirroring tests/test_memory_analysis.py:
+
+1. estimator units — FLOPs/bytes/wire accounting on synthetic HLO text
+   (no compiler in the loop): dot contraction math, fusion-boundary
+   byte counting, while x trip-count scoping, the unknown-trip-count
+   LOWER BOUND (loud, never dropped), mesh=1 collectives costing zero,
+   the ring wire formulas, dtype-aware int8 traffic;
+2. roofline units — bound selection, the overlapped-vs-exposed wire
+   term, tok/s projection;
+3. the pinned-table gates — every registered case has a
+   STABLE_COST_BUDGETS pin and vice versa, the registry injects it, an
+   unpinned case refuses to audit (negative twin 3);
+4. the perf claims re-derived from cost alone on real compiled
+   programs — HLO wire bytes vs profiling/comm_model's analytic ring
+   formulas on ddp/zero1/zero2/zero3, int8 decode HBM < f32's,
+   bucketed-RS wire == unbucketed's, speculative verify ~ (K+1)x — and
+   the negatives: an inflated-FLOPs mutant blows its pinned ceiling
+   (negative twin 1), the f32 paged step audited under the int8 case's
+   budget fails on HBM traffic (negative twin 2).
+"""
+
+import jax
+import pytest
+
+from pytorch_distributed_tpu.analysis.budget import (
+    STABLE_COST_BUDGETS,
+    STABLE_MEMORY_BUDGETS,
+    CostBudget,
+    check_cost,
+    cost_budget_for,
+)
+from pytorch_distributed_tpu.analysis.cost import (
+    V5E_ROOFLINE,
+    RooflineSpec,
+    collective_wire_bytes,
+    estimate_cost,
+    group_size,
+    project_step_time,
+    projected_tok_s,
+)
+from pytorch_distributed_tpu.analysis.registry import registered_cases
+from pytorch_distributed_tpu.config import MeshConfig
+from pytorch_distributed_tpu.profiling import comm_model
+
+
+# --------------------------------------------------------------------------
+# 1. estimator units on synthetic HLO
+# --------------------------------------------------------------------------
+
+
+_DOT = """\
+HloModule synth, is_scheduled=true
+ENTRY %main (p0: f32[4,8], p1: f32[8,16]) -> f32[4,16] {
+  %p0 = f32[4,8]{1,0} parameter(0)
+  %p1 = f32[8,16]{1,0} parameter(1)
+  ROOT %d = f32[4,16]{1,0} dot(f32[4,8]{1,0} %p0, f32[8,16]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+}
+"""
+
+
+def test_dot_contraction_flops_and_bytes():
+    c = estimate_cost(_DOT)
+    # 2 x out(4x16) x contracted(8); parameters are free, the dot moves
+    # its operands (128 + 512 B) plus its output (256 B).
+    assert c.flops == 2 * 4 * 16 * 8
+    assert c.hbm_bytes == 128 + 512 + 256
+    assert c.wire_bytes == 0
+    assert not c.lower_bound
+
+
+_ELEMENTWISE = """\
+HloModule synth, is_scheduled=true
+ENTRY %main (p0: f32[4,16]) -> f32[] {
+  %p0 = f32[4,16]{1,0} parameter(0)
+  %e = f32[4,16]{1,0} exponential(f32[4,16]{1,0} %p0)
+  %z = f32[] constant(0)
+  ROOT %r = f32[] reduce(f32[4,16]{1,0} %e, f32[] %z), dimensions={0,1}, to_apply=%add
+}
+"""
+
+
+def test_elementwise_at_output_reduce_at_input():
+    c = estimate_cost(_ELEMENTWISE)
+    # exponential: 64 output elements; reduce: 64 INPUT elements (every
+    # element participates once — the output is a scalar).
+    assert c.flops == 64 + 64
+
+
+_FUSED = """\
+HloModule synth, is_scheduled=true
+%fused (fp0: f32[4,16]) -> f32[4,16] {
+  %fp0 = f32[4,16]{1,0} parameter(0)
+  %m = f32[4,16]{1,0} multiply(%fp0, %fp0)
+  %a = f32[4,16]{1,0} add(%m, %fp0)
+  ROOT %t = f32[4,16]{1,0} tanh(%a)
+}
+ENTRY %main (p0: f32[4,16]) -> f32[4,16] {
+  %p0 = f32[4,16]{1,0} parameter(0)
+  ROOT %f = f32[4,16]{1,0} fusion(f32[4,16]{1,0} %p0), kind=kLoop, calls=%fused
+}
+"""
+
+_UNFUSED = """\
+HloModule synth, is_scheduled=true
+ENTRY %main (p0: f32[4,16]) -> f32[4,16] {
+  %p0 = f32[4,16]{1,0} parameter(0)
+  %m = f32[4,16]{1,0} multiply(f32[4,16]{1,0} %p0, f32[4,16]{1,0} %p0)
+  %a = f32[4,16]{1,0} add(f32[4,16]{1,0} %m, f32[4,16]{1,0} %p0)
+  ROOT %t = f32[4,16]{1,0} tanh(f32[4,16]{1,0} %a)
+}
+"""
+
+
+def test_fusion_boundary_bytes_not_double_counted():
+    fused = estimate_cost(_FUSED)
+    unfused = estimate_cost(_UNFUSED)
+    # Same math either way (3 elementwise ops x 64 elements)...
+    assert fused.flops == unfused.flops == 3 * 64
+    # ...but the fusion moves ONLY its boundary (one operand + one
+    # output = 512 B); the unfused twin materialises every intermediate
+    # (multiply: 2x256+256, add: 2x256+256, tanh: 256+256 = 2048 B).
+    # Counting fusion internals as traffic would erase exactly the
+    # saving fusion exists to create — this is the double-count
+    # regression gate.
+    assert fused.hbm_bytes == 256 + 256
+    assert unfused.hbm_bytes == 2048
+    assert fused.hbm_bytes < unfused.hbm_bytes
+
+
+_WHILE = """\
+HloModule synth, is_scheduled=true
+%cond (c: (s32[], f32[16])) -> pred[] {
+  %c = (s32[], f32[16]{0}) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[16]{0}) %c), index=0
+  %n = s32[] constant(5)
+  ROOT %lt = pred[] compare(s32[] %i, s32[] %n), direction=LT
+}
+%body (b: (s32[], f32[16])) -> (s32[], f32[16]) {
+  %b = (s32[], f32[16]{0}) parameter(0)
+  %i2 = s32[] get-tuple-element((s32[], f32[16]{0}) %b), index=0
+  %x = f32[16]{0} get-tuple-element((s32[], f32[16]{0}) %b), index=1
+  %one = s32[] constant(1)
+  %i3 = s32[] add(s32[] %i2, s32[] %one)
+  %x2 = f32[16]{0} multiply(f32[16]{0} %x, f32[16]{0} %x)
+  ROOT %out = (s32[], f32[16]{0}) tuple(%i3, %x2)
+}
+ENTRY %main (p0: f32[16]) -> (s32[], f32[16]) {
+  %p0 = f32[16]{0} parameter(0)
+  %zero = s32[] constant(0)
+  %init = (s32[], f32[16]{0}) tuple(%zero, %p0)
+  ROOT %w = (s32[], f32[16]{0}) while((s32[], f32[16]{0}) %init), condition=%cond, body=%body, backend_config={"known_trip_count":{"n":"5"}}
+}
+"""
+
+
+def test_while_body_multiplied_by_trip_count():
+    c = estimate_cost(_WHILE)
+    # Body per trip: add(1) + multiply(16); cond per trip: compare(1).
+    # x5 trips. Nothing else in the entry computes.
+    assert c.flops == 5 * (1 + 16 + 1)
+    assert not c.lower_bound
+    assert c.unknown_trip_whiles == ()
+
+
+def test_unknown_trip_count_is_a_loud_lower_bound():
+    # Strip the backend_config: the body must be counted ONCE (never
+    # silently dropped) and the estimate flagged as a lower bound that
+    # names the while.
+    text = _WHILE.replace(
+        ', backend_config={"known_trip_count":{"n":"5"}}', ""
+    )
+    c = estimate_cost(text)
+    assert c.flops == 1 + 16 + 1
+    assert c.lower_bound
+    assert c.unknown_trip_whiles == ("main/w",)
+    # And a pinned budget refuses to certify it unless explicitly
+    # acknowledged.
+    findings, stats = check_cost(c, CostBudget(max_flops=10_000))
+    assert [f.code for f in findings] == ["cost-lower-bound"]
+    assert findings[0].severity == "error"
+    findings, _ = check_cost(
+        c, CostBudget(max_flops=10_000, allow_lower_bound=True)
+    )
+    assert findings == []
+
+
+_COLLECTIVE = """\
+HloModule synth, is_scheduled=true, num_partitions=8
+ENTRY %main (p0: f32[1024]) -> f32[1024] {
+  %p0 = f32[1024]{0} parameter(0)
+  ROOT %ar = f32[1024]{0} all-reduce(f32[1024]{0} %p0), replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+}
+"""
+
+
+def test_all_reduce_ring_wire_bytes():
+    c = estimate_cost(_COLLECTIVE)
+    # 4096-byte payload over an 8-member ring: 2 x B x 7/8.
+    assert c.wire_bytes == int(2 * 4096 * 7 / 8)
+    assert c.wire_by_collective == {"all-reduce": c.wire_bytes}
+    assert c.num_partitions == 8
+
+
+def test_mesh1_collective_costs_zero_wire_bytes():
+    # A single-member group — what a collective compiles to on a mesh=1
+    # axis — moves nothing, regardless of payload size.
+    text = _COLLECTIVE.replace(
+        "replica_groups={{0,1,2,3,4,5,6,7}}", "replica_groups={{0}}"
+    ).replace("num_partitions=8", "num_partitions=1")
+    c = estimate_cost(text)
+    assert c.wire_bytes == 0
+    assert c.wire_by_collective == {"all-reduce": 0}
+
+
+def test_iota_replica_groups_parse():
+    assert group_size("replica_groups=[2,4]<=[8]") == 4
+    assert group_size("replica_groups={{0,2},{1,3}}") == 2
+    assert group_size("replica_groups={{0}}") == 1
+    # Implicit all-devices form falls back to the module default.
+    assert group_size("channel_id=1", default=8) == 8
+
+
+@pytest.mark.parametrize(
+    "base,payload,n,expect",
+    [
+        ("all-reduce", 800, 8, 2 * 800 * 7 // 8),
+        ("all-gather", 800, 8, 800 * 7 // 8),
+        ("reduce-scatter", 800, 8, 800 * 7 // 8),
+        ("all-to-all", 800, 8, 800 * 7 // 8),
+        ("collective-permute", 800, 8, 800),
+        ("collective-broadcast", 800, 8, 800),
+        ("all-reduce", 800, 1, 0),
+        ("all-gather", 800, 1, 0),
+    ],
+)
+def test_ring_wire_formulas(base, payload, n, expect):
+    assert collective_wire_bytes(base, payload, n) == expect
+
+
+_INT8 = """\
+HloModule synth, is_scheduled=true
+ENTRY %main (p0: s8[64,16], p1: f32[64,16]) -> f32[64,16] {
+  %p0 = s8[64,16]{1,0} parameter(0)
+  %p1 = f32[64,16]{1,0} parameter(1)
+  %cv = f32[64,16]{1,0} convert(s8[64,16]{1,0} %p0)
+  ROOT %m = f32[64,16]{1,0} multiply(f32[64,16]{1,0} %cv, f32[64,16]{1,0} %p1)
+}
+"""
+
+
+def test_int8_traffic_is_dtype_aware():
+    c = estimate_cost(_INT8)
+    # The convert READS 1024 int8 bytes and writes 4096 f32 — the
+    # 0.25x read is exactly the traffic int8 pages exist to buy;
+    # convert is movement, not math.
+    assert c.hbm_bytes == (1024 + 4096) + (4096 + 4096 + 4096)
+    assert c.flops == 64 * 16  # only the multiply
+
+
+# --------------------------------------------------------------------------
+# 2. roofline units
+# --------------------------------------------------------------------------
+
+
+def _fake_cost(flops, hbm, wire):
+    from pytorch_distributed_tpu.analysis.cost import (
+        ComputationCost,
+        ProgramCost,
+    )
+
+    entry = ComputationCost("main", flops, hbm, wire, {}, ())
+    return ProgramCost(
+        flops=flops, hbm_bytes=hbm, wire_bytes=wire, wire_by_collective={},
+        unknown_trip_whiles=(), num_partitions=8, entry=entry,
+    )
+
+
+def test_roofline_bound_selection():
+    spec = RooflineSpec("unit", peak_flops=100.0, hbm_bytes_per_s=10.0,
+                        ici_bytes_per_s=1.0)
+    # Compute-bound: 1000 flops = 10 s vs 10 bytes = 1 s.
+    p = project_step_time(_fake_cost(1000, 10, 0), spec)
+    assert p["bound"] == "compute"
+    assert p["projected_step_s"] == pytest.approx(10.0)
+    # Bandwidth-bound: 10 flops = 0.1 s vs 100 bytes = 10 s.
+    p = project_step_time(_fake_cost(10, 100, 0), spec)
+    assert p["bound"] == "bandwidth"
+    assert p["projected_step_s"] == pytest.approx(10.0)
+    assert p["ridge_intensity"] == pytest.approx(10.0)
+
+
+def test_roofline_wire_exposed_vs_overlapped():
+    spec = RooflineSpec("unit", peak_flops=100.0, hbm_bytes_per_s=10.0,
+                        ici_bytes_per_s=1.0)
+    cost = _fake_cost(100, 10, 2)  # 1 s compute, 1 s hbm, 2 s wire
+    exposed = project_step_time(cost, spec, overlapped_comm=False)
+    overlapped = project_step_time(cost, spec, overlapped_comm=True)
+    # No overlap contract: the wire term serialises on top (1 + 2 s);
+    # with one: it hides under the larger of compute/bandwidth, so the
+    # step is just the wire time.
+    assert exposed["projected_step_s"] == pytest.approx(3.0)
+    assert overlapped["projected_step_s"] == pytest.approx(2.0)
+    assert exposed["bound"] == overlapped["bound"] == "wire"
+
+
+def test_projected_tok_s():
+    spec = RooflineSpec("unit", peak_flops=100.0, hbm_bytes_per_s=10.0,
+                        ici_bytes_per_s=1.0)
+    cost = _fake_cost(100, 1, 0)  # 1 s/step
+    assert projected_tok_s(cost, 4, spec) == pytest.approx(4.0)
+
+
+def test_check_cost_ceilings_inclusive():
+    cost = _fake_cost(1000, 500, 10)
+    # At the pin exactly: clean (ceilings are inclusive, like memory).
+    findings, stats = check_cost(
+        cost, CostBudget(max_flops=1000, max_hbm_bytes=500,
+                         max_wire_bytes=10)
+    )
+    assert findings == []
+    assert stats["flops"] == 1000
+    # One past any of them: the named error.
+    findings, _ = check_cost(cost, CostBudget(max_wire_bytes=9))
+    assert [f.code for f in findings] == ["cost-wire-bytes-exceeded"]
+    assert findings[0].severity == "error"
+
+
+# --------------------------------------------------------------------------
+# 3. pinned-table gates + the missing-pin refusal (negative twin 3)
+# --------------------------------------------------------------------------
+
+
+def test_every_registered_case_has_a_cost_pin():
+    cases = set(registered_cases())
+    pinned = set(STABLE_COST_BUDGETS)
+    assert cases - pinned == set(), (
+        "registered cases without a STABLE_COST_BUDGETS pin"
+    )
+    assert pinned - cases == set(), (
+        "stale STABLE_COST_BUDGETS entries for unregistered cases"
+    )
+
+
+def test_cost_budget_for_unpinned_case_raises_with_fix():
+    with pytest.raises(KeyError, match="no pinned cost budget"):
+        cost_budget_for("not-a-registered-case")
+
+
+def test_registry_refuses_to_build_an_unpinned_case():
+    # The PR-15 discipline extended to cost: the registry wrapper
+    # injects the pin at build time, so a case that was never measured
+    # cannot produce an auditable program at all.
+    from pytorch_distributed_tpu.analysis.budget import MemoryBudget
+    from pytorch_distributed_tpu.analysis.registry import (
+        _with_pinned_budgets,
+    )
+
+    build = _with_pinned_budgets(
+        "never-measured-case", lambda: (None, (), None, {})
+    )
+    with pytest.raises(KeyError, match="no pinned memory budget"):
+        build()
+    # Even with a memory pin supplied, the missing COST pin refuses.
+    build = _with_pinned_budgets(
+        "never-measured-case",
+        lambda: (None, (), None, {"memory_budget": MemoryBudget()}),
+    )
+    with pytest.raises(KeyError, match="no pinned cost budget"):
+        build()
+
+
+def test_decode_loop_body_peaks_are_pinned():
+    # The carried PR-15 follow-up: every decode-family memory pin now
+    # carries the steady-state while-body ceiling too.
+    decode_cases = [
+        name for name in STABLE_MEMORY_BUDGETS
+        if "decode" in name
+    ]
+    assert decode_cases, "no decode cases registered?"
+    for name in decode_cases:
+        assert (
+            STABLE_MEMORY_BUDGETS[name].max_loop_body_peak_bytes is not None
+        ), f"{name}: max_loop_body_peak_bytes not pinned"
+
+
+# --------------------------------------------------------------------------
+# 4. perf claims re-derived from cost alone + the negative twins
+# --------------------------------------------------------------------------
+
+
+_N_CHIPS = 8
+
+
+@pytest.fixture(scope="module")
+def compiled_cost():
+    """Lazy per-case (ProgramCost, hlo_text) cache over the registry,
+    plus the unregistered zero1 twin (built directly so the registry
+    stays at its pinned 37 cases)."""
+    from pytorch_distributed_tpu.analysis.registry import _build_explicit
+
+    cases = registered_cases()
+    cache = {}
+
+    def get(name):
+        if name not in cache:
+            if name == "zero1":
+                fn, args, _, _ = _build_explicit(
+                    MeshConfig(fsdp=_N_CHIPS, strategy="shard_opt")
+                )
+            else:
+                fn, args, _, _ = cases[name].build()
+            text = fn.lower(*args).compile().as_text()
+            n_params = sum(
+                x.size for x in jax.tree.leaves(
+                    getattr(args[0], "params", None)
+                )
+            ) if hasattr(args[0], "params") else None
+            cache[name] = (estimate_cost(text), text, n_params)
+        return cache[name]
+
+    return get
+
+
+def test_wire_bytes_match_comm_model_ddp(compiled_cost):
+    cost, _, n_params = compiled_cost("ddp")
+    model = comm_model.ddp_comm_bytes_per_step(n_params, _N_CHIPS)
+    # The only slack is the handful of scalar loss/grad-norm reductions
+    # (a few bytes against ~750 KiB of gradient traffic).
+    assert cost.wire_bytes == pytest.approx(model["total"], rel=1e-3)
+    assert set(cost.wire_by_collective) == {"all-reduce"}
+
+
+def test_wire_bytes_match_comm_model_zero1(compiled_cost):
+    cost, _, n_params = compiled_cost("zero1")
+    ddp_params = compiled_cost("ddp")[2]
+    model = comm_model.zero1_comm_bytes_per_step(ddp_params, _N_CHIPS)
+    # ZeRO-1 pays DDP's grad all-reduce PLUS the param re-materialise
+    # all-reduce — exactly 2x DDP's wire, all of it all-reduce.
+    assert cost.wire_bytes == pytest.approx(model["total"], rel=1e-3)
+    assert set(cost.wire_by_collective) == {"all-reduce"}
+    ddp_cost = compiled_cost("ddp")[0]
+    assert cost.wire_bytes == pytest.approx(
+        2 * ddp_cost.wire_bytes, rel=1e-3
+    )
+
+
+def test_wire_bytes_match_comm_model_zero2(compiled_cost):
+    cost, _, _ = compiled_cost("zero2")
+    n_params = compiled_cost("ddp")[2]
+    model = comm_model.zero2_comm_bytes_per_step(n_params, _N_CHIPS)
+    assert cost.wire_bytes == pytest.approx(model["total"], rel=1e-3)
+    # And the split matches the formula's parts: the reduce-scatter
+    # carries G x (N-1)/N exactly.
+    assert cost.wire_by_collective["reduce-scatter"] == pytest.approx(
+        model["reduce_scatter"], rel=1e-3
+    )
+
+
+def test_wire_bytes_match_comm_model_zero3(compiled_cost):
+    cost, _, _ = compiled_cost("fsdp")
+    n_params = compiled_cost("ddp")[2]
+    model = comm_model.fsdp_comm_bytes_per_step(
+        n_params, _N_CHIPS, param_bytes=4
+    )
+    # Looser tolerance: the analytic model charges the remat re-gather
+    # for EVERY leaf, but the compiled schedule keeps the (small)
+    # embedding tables live through backward instead of re-gathering
+    # them — the HLO moves slightly less than the formula's ceiling.
+    assert cost.wire_bytes <= model["total"]
+    assert cost.wire_bytes == pytest.approx(model["total"], rel=0.05)
+    assert {"all-gather", "reduce-scatter"} <= set(cost.wire_by_collective)
+
+
+def test_int8_decode_hbm_traffic_below_f32(compiled_cost):
+    f32, _, _ = compiled_cost("decode_paged_step")
+    q8, _, _ = compiled_cost("decode_paged_step_q8")
+    # The int8-pages claim as TRAFFIC, not just allocation: the q8 step
+    # moves well under the f32 step's bytes (the pool reads shrink
+    # 0.3125x, diluted by unquantized weights/activations), while its
+    # flops are slightly HIGHER (the dequant math is not free).
+    assert q8.hbm_bytes < 0.7 * f32.hbm_bytes
+    assert q8.flops >= f32.flops
+
+
+def test_bucketed_rs_moves_same_bytes_fewer_instructions(compiled_cost):
+    plain, _, _ = compiled_cost("zero2")
+    bucketed, _, _ = compiled_cost("zero2_bucketed")
+    # Coalescing moves INSTRUCTIONS, not bytes: the gradient wire
+    # traffic is conserved exactly (instruction counts are pinned
+    # separately in STABLE_MAX_COUNTS: 16 reduce-scatters -> 2).
+    assert (
+        bucketed.wire_by_collective["reduce-scatter"]
+        == plain.wire_by_collective["reduce-scatter"]
+    )
+    assert bucketed.wire_bytes == pytest.approx(
+        plain.wire_bytes, rel=1e-3
+    )
+
+
+def test_speculative_verify_flops_scale_with_k(compiled_cost):
+    plain, _, _ = compiled_cost("decode_paged_step")
+    spec, _, _ = compiled_cost("decode_paged_spec_step")
+    # The [slots, K+1] verify forward at K=3 does ~4x the plain step's
+    # math in one dispatch (slightly under: the per-step sampling /
+    # bookkeeping does not scale with K).
+    ratio = spec.flops / plain.flops
+    assert 3.0 < ratio <= 4.2
+
+
+def test_inflated_flops_mutant_blows_the_pin(compiled_cost):
+    # Negative twin 1: duplicate one dot instruction in the compiled
+    # ddp module — the textual form of "an innocent refactor doubled a
+    # matmul" — and the pinned ceiling must catch it loudly.
+    cost, text, _ = compiled_cost("ddp")
+    budget = cost_budget_for("ddp")
+    clean, _ = check_cost(cost, budget)
+    assert clean == []
+    lines = text.splitlines()
+    dot_line = next(
+        ln for ln in lines
+        if " dot(" in ln and "ROOT" not in ln
+    )
+    idx = lines.index(dot_line)
+    mutant_text = "\n".join(lines[: idx + 1] + [dot_line] + lines[idx + 1:])
+    mutant = estimate_cost(mutant_text)
+    assert mutant.flops > cost.flops
+    findings, _ = check_cost(mutant, budget)
+    assert any(f.code == "cost-flops-exceeded" for f in findings)
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_f32_pages_fail_the_int8_cost_budget(compiled_cost):
+    # Negative twin 2: the f32 paged step audited under the q8 case's
+    # pinned budget — what a silent kv_quant regression looks like to
+    # the cost gate: ~1.8x the pinned HBM traffic.
+    f32, _, _ = compiled_cost("decode_paged_step")
+    q8_budget = cost_budget_for("decode_paged_step_q8")
+    findings, _ = check_cost(f32, q8_budget)
+    codes = [f.code for f in findings]
+    assert "cost-hbm-bytes-exceeded" in codes
+    [f] = [f for f in findings if f.code == "cost-hbm-bytes-exceeded"]
+    assert f.severity == "error"
+
+
+def test_audit_program_cost_check_end_to_end(compiled_cost):
+    # Through audit_program itself: the registered case passes under
+    # its pin, summary["cost"] carries the stats and a roofline
+    # projection, and tightening any ceiling by one byte fails it.
+    import dataclasses
+
+    from pytorch_distributed_tpu.analysis.audit import audit_program
+
+    cases = registered_cases()
+    fn, args, budget, kw = cases["ddp"].build()
+    report = audit_program(
+        fn, args, budget, label="ddp", checks=("cost",), **{
+            k: v for k, v in kw.items()
+            if k in ("donate_argnums", "expect_donation", "cost_budget")
+        }
+    )
+    assert report.clean()
+    stats = report.summary["cost"]
+    assert stats["flops"] > 0
+    assert stats["roofline"]["projected_step_s"] > 0
+    assert stats["roofline"]["bound"] in ("compute", "bandwidth", "wire")
+
+    tight = dataclasses.replace(
+        kw["cost_budget"], max_hbm_bytes=stats["hbm_bytes"] - 1
+    )
+    report = audit_program(
+        fn, args, budget, label="ddp-tight", checks=("cost",),
+        cost_budget=tight,
+    )
+    assert not report.clean()
+    assert any(
+        f.code == "cost-hbm-bytes-exceeded" for f in report.errors
+    )
+
+
+def test_v5e_roofline_matches_chip_spec():
+    # The default roofline prices at the same public-spec constants
+    # profiling/comm_model records — one source of truth for "what a
+    # v5e can do", conservatively bracketed.
+    assert V5E_ROOFLINE.peak_flops == comm_model.V5E.peak_bf16_flops
+    assert V5E_ROOFLINE.ici_bytes_per_s == comm_model.V5E.ici_eff_low

@@ -202,6 +202,39 @@ def test_loop_body_scoping():
     )
 
 
+def test_loop_body_peak_ceiling_trips():
+    # The steady-state-HBM contract: a while body's liveness peak over
+    # its pinned ceiling is an error naming the per-body peaks, and the
+    # measured value exactly at the pin passes (inclusive, like every
+    # other ceiling).
+    w = jnp.ones((64, 64), jnp.float32)
+
+    def step(w):
+        def body(_, acc):
+            return acc @ acc + 1.0
+
+        return jax.lax.fori_loop(0, 4, body, w)
+
+    _, text = _compiled_text(step, (w,))
+    est = estimate_memory(text)
+    peak = max(b.peak_live_bytes for b in est.loop_bodies().values())
+    findings, stats = check_memory(
+        est,
+        MemoryBudget(max_loop_body_peak_bytes=peak - 1),
+        donated_params=frozenset({0}),
+    )
+    [f] = [f for f in findings if f.code == "loop-body-peak-exceeded"]
+    assert f.severity == "error"
+    assert f.detail["loop_body_peak_bytes"] == peak
+    assert stats["loop_body_peak_bytes"] == peak
+    findings, _ = check_memory(
+        est,
+        MemoryBudget(max_loop_body_peak_bytes=peak),
+        donated_params=frozenset({0}),
+    )
+    assert findings == []
+
+
 def test_memory_budget_ceiling_trips():
     w = jnp.ones((64, 64), jnp.float32)
     _, text = _compiled_text(lambda w: w * 2.0, (w,))
