@@ -442,6 +442,29 @@ STABLE_MEMORY_BUDGETS: dict[str, MemoryBudget] = {
         max_live_bytes=213_156, max_donated_bytes=16_384,
         max_loop_body_peak_bytes=115_736,
     ),
+    "decode_paged_kv_export": MemoryBudget(
+        max_live_bytes=73_736,
+        max_loop_body_peak_bytes=0,
+        note="pool + gathered pages both live: export does NOT donate "
+             "(the source row must survive until complete_handoff); "
+             "no while loop, so the body peak is zero by construction",
+    ),
+    "decode_paged_kv_import": MemoryBudget(
+        max_live_bytes=114_716, max_donated_bytes=65_536,
+        max_loop_body_peak_bytes=73_752,
+    ),
+    "decode_paged_kv_import_q8": MemoryBudget(
+        max_live_bytes=33_824, max_donated_bytes=20_480,
+        max_loop_body_peak_bytes=18_456,
+        note="int8 pages + per-token scale leaves scatter as-is: "
+             "0.3125x the f32 import's pool bytes",
+    ),
+    "decode_paged_kv_import_tp": MemoryBudget(
+        max_live_bytes=57_372, max_donated_bytes=32_768,
+        max_loop_body_peak_bytes=36_888,
+        note="per-shard bytes: each tensor=2 shard scatters its own "
+             "head slice, half the single-device pool",
+    ),
     "ddp_pjit": MemoryBudget(max_live_bytes=2_458_808),
     "fsdp_pjit": MemoryBudget(max_live_bytes=1_094_776),
     "zero2_pjit": MemoryBudget(max_live_bytes=1_558_768),
@@ -735,6 +758,31 @@ STABLE_COST_BUDGETS: dict[str, CostBudget] = {
     "decode_batched_step_tp_lora": CostBudget(
         max_flops=390_074, max_hbm_bytes=1_133_610,
         max_wire_bytes=6_144,
+    ),
+    "decode_paged_kv_export": CostBudget(
+        max_flops=12, max_hbm_bytes=81_936,
+        max_wire_bytes=0,
+        note="a pure gather: ~zero flops, and the HBM bill is the pool "
+             "read + page write — any math appearing here is a bug",
+    ),
+    "decode_paged_kv_import": CostBudget(
+        max_flops=4_190, max_hbm_bytes=328_092,
+        max_wire_bytes=0,
+        note="a pure scatter at freshly allocated page ids; flops are "
+             "the table-indexing arithmetic, not tensor math",
+    ),
+    "decode_paged_kv_import_q8": CostBudget(
+        max_flops=4_518, max_hbm_bytes=103_176,
+        max_wire_bytes=0,
+        note="HBM 0.31x the f32 import: int8 pages move int8 bytes, "
+             "and the zero q8-cast pin keeps it that way",
+    ),
+    "decode_paged_kv_import_tp": CostBudget(
+        max_flops=2_142, max_hbm_bytes=164_252,
+        max_wire_bytes=0,
+        note="wire bytes ZERO under tensor=2: each shard scatters its "
+             "own head slice — a collective here would silently "
+             "multiply the handoff's wire cost",
     ),
     "ddp_pjit": CostBudget(
         max_flops=24_735_275, max_hbm_bytes=23_540_208,

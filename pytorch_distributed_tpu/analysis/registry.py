@@ -271,10 +271,12 @@ def _lora_registry(cfg, rank: int | None):
 def _build_paged_engine(
     kind: str,
     budget: CollectiveBudget | None = NO_COLLECTIVES,
+    mesh_cfg: MeshConfig | None = None,
     kv_quant: str = "none",
     weight_quant: str = "none",
     lora_rank: int | None = None,
     speculative_k: int = 0,
+    role: str = "colocated",
     audit_extra: dict | None = None,
 ):
     """A paged slot-batched serving program
@@ -284,7 +286,14 @@ def _build_paged_engine(
     — one executable covers every table content, and the audited
     contract is strict donation of the WHOLE page pool (a rejected
     alias would double-buffer the pool every token) plus NO_COLLECTIVES
-    on the single-device programs."""
+    on the single-device programs.
+
+    The kv handoff kinds ride the same builder: ``kv_import`` (the
+    decode-worker scatter) donates the pool like every other paged
+    program; ``kv_export`` (the prefill-worker gather) deliberately has
+    NO donation — the source row must survive until the destination
+    confirms (PR-6 fault model), so aliasing the pool into the gathered
+    pages would be a correctness bug, not an optimisation."""
     from pytorch_distributed_tpu.models import get_model
     from pytorch_distributed_tpu.serving.engine import (
         PagedBatchedDecodeEngine,
@@ -295,16 +304,24 @@ def _build_paged_engine(
     params = get_model(cfg).init(domain_key(42, "init"), cfg)
     engine = PagedBatchedDecodeEngine(
         cfg, slots=4, max_len=16, page_size=8, pool_pages=8,
-        prefill_chunk=8, kv_quant=kv_quant, weight_quant=weight_quant,
+        prefill_chunk=8, mesh_cfg=mesh_cfg, kv_quant=kv_quant,
+        weight_quant=weight_quant,
         adapters=_lora_registry(cfg, lora_rank),
-        speculative_k=speculative_k,
+        speculative_k=speculative_k, role=role,
     )
     fn = engine.program(kind)
     args = engine.example_args(kind, engine._place_params(params))
+    ca = engine.CACHE_ARGNUM.get(kind)
+    # kv_export is the one paged program with NO donation contract (the
+    # source pool must outlive the gather — see class docstring), so
+    # the audit must not apply the harness's default donate_argnums=(0,).
+    donation = (
+        {"expect_donation": False} if ca is None
+        else {"donate_argnums": (ca,), "donation_strict": True}
+    )
     return fn, args, budget, {
         "compute_dtype": cfg.dtype,
-        "donate_argnums": (engine.CACHE_ARGNUM[kind],),
-        "donation_strict": True,
+        **donation,
         **(audit_extra or {}),
     }
 
@@ -772,6 +789,61 @@ def registered_cases() -> dict[str, AuditCase]:
                 budget_case="decode_batched_step_tp",
             ),
         ),
+        # Disaggregated-serving kv handoff programs (serving/engine.py
+        # export_handoff/import_handoff): the prefill-worker gather and
+        # the decode-worker scatter that ship a finished row's pages +
+        # block table between replicas. Contracts under audit: the
+        # bodies are pure page movement — NO collectives even under TP
+        # (each shard gathers/scatters ITS OWN head slice; resharding
+        # in a handoff would be a silent wire-cost regression) — the
+        # import donates the destination pool like every paged program,
+        # and the export deliberately does NOT donate (the source row
+        # must survive until the destination confirms; PR-6 fault
+        # model).
+        AuditCase(
+            "decode_paged_kv_export",
+            "kv handoff export (prefill worker gathers one parked "
+            "row's pages off the pool at a traced block table): NO "
+            "donation by design — the source pool outlives the wire "
+            "copy until complete_handoff — and any collective is a bug",
+            1,
+            lambda: _build_paged_engine("kv_export", role="prefill"),
+        ),
+        AuditCase(
+            "decode_paged_kv_import",
+            "kv handoff import (decode worker scatters shipped pages "
+            "into its pool at freshly allocated page ids): strict "
+            "donation of the destination pool, any collective is a bug",
+            1,
+            lambda: _build_paged_engine("kv_import", role="decode"),
+        ),
+        AuditCase(
+            "decode_paged_kv_import_q8",
+            "int8 kv handoff import (int8 pages + per-row scale leaves "
+            "scatter as-is): strict donation, no collectives, and a "
+            "ZERO q8 cast budget — a handoff must never round-trip "
+            "quantized pages through f32",
+            1,
+            lambda: _build_paged_engine(
+                "kv_import", kv_quant="int8", role="decode",
+                audit_extra={
+                    "q8_cast_budget": {"to_int8": 0, "from_int8": 0},
+                },
+            ),
+        ),
+        AuditCase(
+            "decode_paged_kv_import_tp",
+            "kv handoff import over tensor=2 (head-sharded pool): each "
+            "shard scatters its OWN head slice of the shipped pages — "
+            "NO collectives pinned, because resharding inside a "
+            "handoff would silently multiply the wire cost",
+            2,
+            lambda: _build_paged_engine(
+                "kv_import",
+                mesh_cfg=MeshConfig(tensor=2, strategy="no_shard"),
+                role="decode",
+            ),
+        ),
         # pjit twins of the explicit cases (parallel/api.py). Budgets per
         # _build_pjit's docstring: derived where the partitioner's op set
         # is the written contract, relaxed/none where it reshards freely.
@@ -906,6 +978,14 @@ ENGINE_PROGRAM_CASES: dict[str, dict[str, tuple[str, ...]]] = {
             "decode_paged_step_lora",
         ),
         "decode_spec_step": ("decode_paged_spec_step",),
+        # kv_export has no CACHE_ARGNUM entry (no donation by design),
+        # so the coverage gate doesn't require it here — its case
+        # (decode_paged_kv_export) registers standalone above.
+        "kv_import": (
+            "decode_paged_kv_import",
+            "decode_paged_kv_import_q8",
+            "decode_paged_kv_import_tp",
+        ),
     },
 }
 

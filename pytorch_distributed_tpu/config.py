@@ -442,7 +442,29 @@ class MeshConfig:
         "pipe", "data", "fsdp", "expert", "seq", "tensor"
     )
 
+    # Device subset for this mesh: the process-local ``jax.devices()``
+    # ids this mesh builds over, in mesh order. None keeps the historic
+    # behaviour (first ``num_devices`` of ``jax.devices()``). This is
+    # how a serving fleet pins each replica to its OWN slice of the
+    # machine (e.g. 4 replicas x TP=2 over 8 devices) instead of every
+    # replica time-slicing device 0 — the mesh is otherwise identical,
+    # so programs, shardings, and pinned collective budgets are
+    # untouched by placement.
+    device_ids: tuple[int, ...] | None = None
+
     def __post_init__(self) -> None:
+        if self.device_ids is not None:
+            ids = tuple(int(d) for d in self.device_ids)
+            object.__setattr__(self, "device_ids", ids)
+            if len(set(ids)) != len(ids):
+                raise ValueError(
+                    f"device_ids must be unique, got {ids}"
+                )
+            if len(ids) != self.num_devices:
+                raise ValueError(
+                    f"device_ids has {len(ids)} entries but the mesh "
+                    f"needs {self.num_devices} devices"
+                )
         if self.strategy not in (
             "full_shard", "shard_grad_op", "shard_opt", "no_shard"
         ):

@@ -48,9 +48,24 @@ def process_info() -> dict:
 
 
 def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
-    """Build a Mesh of shape cfg.shape over the given (or all) devices."""
+    """Build a Mesh of shape cfg.shape over the given (or all) devices.
+
+    When ``cfg.device_ids`` is set and no explicit ``devices`` override
+    is passed, the mesh is built over exactly those process-local
+    device ids, in order — the placement hook that lets a serving fleet
+    give each replica its own disjoint slice of the machine."""
     if devices is None:
-        devices = jax.devices()
+        if cfg.device_ids is not None:
+            by_id = {d.id: d for d in jax.devices()}
+            missing = [i for i in cfg.device_ids if i not in by_id]
+            if missing:
+                raise ValueError(
+                    f"device_ids {missing} not present among "
+                    f"jax.devices() ids {sorted(by_id)}"
+                )
+            devices = [by_id[i] for i in cfg.device_ids]
+        else:
+            devices = jax.devices()
     n = cfg.num_devices
     if n > len(devices):
         raise ValueError(

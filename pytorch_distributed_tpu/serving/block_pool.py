@@ -107,6 +107,13 @@ class BlockPool:
             "prefix_hit_tokens": 0,
             "evictions": 0,
             "peak_pages_in_use": 0,
+            # Disaggregated serving's kv_handoff traffic through THIS
+            # pool: pages landed by import_handoff / released by
+            # complete_handoff (engine.py) — the page-level ledger the
+            # handoff-bytes figures in serving_disagg_bench.json roll
+            # up from.
+            "handoff_pages_in": 0,
+            "handoff_pages_out": 0,
         }
 
     # -- accounting --------------------------------------------------------
@@ -226,6 +233,20 @@ class BlockPool:
             self._ref[pid] = 1
         self._bump_peak()
         return out
+
+    def alloc_for_handoff(self, n: int) -> list[int] | None:
+        """``alloc`` for a kv_handoff import: same allocator, same
+        None-on-exhaustion contract, plus the handoff page ledger the
+        disaggregation bench reports."""
+        pids = self.alloc(n)
+        if pids is not None:
+            self.stats["handoff_pages_in"] += n
+        return pids
+
+    def note_handoff_out(self, n: int) -> None:
+        """Count a completed export's pages (released by the engine's
+        ``complete_handoff`` through the normal ``release`` path)."""
+        self.stats["handoff_pages_out"] += n
 
     def _evictable(self) -> str | None:
         for key, chunk in self._cache.items():  # LRU-first

@@ -112,6 +112,10 @@ from pytorch_distributed_tpu.utils.logging import log_event
 
 _PROGRAM_KINDS = ("prefill", "decode_run", "decode_step")
 _BATCHED_PROGRAM_KINDS = ("prefill", "decode_step", "decode_spec_step")
+# Disaggregation-only paged programs: gather a row's KV pages off a
+# PREFILL worker's pool / scatter them into a DECODE worker's (the
+# kv_handoff wire path). Never dispatched by the tick scheduler.
+_KV_PROGRAM_KINDS = ("kv_export", "kv_import")
 _EMPTY_DRAFT = np.zeros((0,), np.int32)
 
 
@@ -210,6 +214,43 @@ def _select_mode(
     return "zero3", mesh_cfg, None, mesh_cfg.prefetch_buffers
 
 
+# Disaggregated-serving roles (uniform ``stats()["role"]`` vocabulary).
+# ``colocated`` engines run prefill AND decode (the historic behaviour);
+# ``prefill`` workers run chunked prefill only and hand finished KV
+# state off; ``decode`` workers accept handoffs/adoptions and run the
+# decode tick only. Role is pure host-side scheduling — every role runs
+# the SAME compiled programs (plus the kv transfer programs), so pinned
+# budgets and compile counts are role-invariant.
+ENGINE_ROLES = ("colocated", "prefill", "decode")
+
+
+def _check_role(role: str) -> str:
+    if role not in ENGINE_ROLES:
+        raise ValueError(
+            f"role must be one of {ENGINE_ROLES}, got {role!r}"
+        )
+    return role
+
+
+def _resolve_device(device):
+    """Resolve an int device id (or a ``jax.Device``) to the Device
+    object, validating it exists on this process. The single-device
+    engines take ``device=`` so a serving fleet can pin each replica to
+    its own chip instead of every replica landing on the default
+    device; meshed engines place via ``MeshConfig.device_ids``."""
+    if device is None:
+        return None
+    if not isinstance(device, (int, np.integer)):
+        return device  # already a jax.Device
+    for d in jax.devices():
+        if d.id == int(device):
+            return d
+    raise ValueError(
+        f"device id {device} not present among jax.devices() ids "
+        f"{sorted(d.id for d in jax.devices())}"
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class BucketSpec:
     """Prompt-length buckets. A request of length T compiles (at most)
@@ -273,6 +314,7 @@ class DecodeEngine:
         pool_max_entries: int = 8,
         nan_guard: bool = True,
         weight_quant: str = "none",
+        device: int | None = None,
     ) -> None:
         if max_len > cfg.n_ctx:
             raise ValueError(
@@ -289,6 +331,12 @@ class DecodeEngine:
         self.mode, self.mesh_cfg, self._n_kv, self._prefetch_buffers = (
             _select_mode(cfg, mesh_cfg, entry="DecodeEngine")
         )
+        self.device = _resolve_device(device)
+        if self.device is not None and self.mode != "plain":
+            raise ValueError(
+                "device= pins the single-device (plain) engine to one "
+                "chip; meshed modes place via MeshConfig.device_ids"
+            )
         self.weight_quant = _check_quant_arg("weight_quant", weight_quant)
         if self.weight_quant != "none" and self.mode == "zero3":
             raise NotImplementedError(
@@ -367,6 +415,8 @@ class DecodeEngine:
         consumers never need hasattr probes."""
         return {
             "engine": type(self).__name__,
+            "role": "colocated",
+            "device_ids": self.device_ids(),
             "queue_depth": 0,
             "queue_depth_by_tier": {name: 0 for name in PRIORITIES},
             "slots": None,
@@ -384,6 +434,15 @@ class DecodeEngine:
             "counters": dict(self.counters),
         }
 
+    def device_ids(self) -> list[int]:
+        """Process-local device ids this engine's programs run on —
+        the placement figure ``stats()`` reports so a fleet operator
+        can SEE that replicas landed on disjoint hardware."""
+        if self.mode == "plain":
+            d = self.device if self.device is not None else jax.devices()[0]
+            return [d.id]
+        return [d.id for d in self._mesh.devices.flat]
+
     # -- cache pool --------------------------------------------------------
 
     def new_cache(self, batch: int) -> decode.Cache:
@@ -396,9 +455,15 @@ class DecodeEngine:
             # n_kv view forward sees inside shard_map.
             full = decode.init_cache(self.cfg, batch, self.max_len)
             return jax.device_put(full, self._cache_sharding())
-        return decode.init_cache(
+        cache = decode.init_cache(
             self.cfg, batch, self.max_len, n_kv=self._n_kv
         )
+        if self.device is not None:
+            # Committed inputs pin the jitted programs' outputs to the
+            # same chip, so one device_put at allocation places the
+            # whole request's compute.
+            cache = jax.device_put(cache, self.device)
+        return cache
 
     def _cache_bytes(self, batch: int) -> int:
         return batch * self.max_len * _kv_bytes_per_position(self.cfg)
@@ -604,10 +669,20 @@ class DecodeEngine:
                 q = quantize_decode_params(params)
                 if self.mode != "plain":
                     q = jax.device_put(q, self._param_shardings)
+                elif self.device is not None:
+                    q = jax.device_put(q, self.device)
                 self._prepared = (params, q)
             return self._prepared[1]
         if self.mode == "plain":
-            return params
+            if self.device is None:
+                return params
+            # Pin once per params tree (identity memo): committed params
+            # + committed cache put every program output on self.device.
+            if self._prepared is None or self._prepared[0] is not params:
+                self._prepared = (
+                    params, jax.device_put(params, self.device)
+                )
+            return self._prepared[1]
         # No-op when already placed, so repeat calls pay nothing.
         return jax.device_put(params, self._param_shardings)
 
@@ -1033,6 +1108,7 @@ class BatchedDecodeEngine:
         speculative_k: int = 0,
         spec_ngram: int = 2,
         draft_hook=None,
+        device: int | None = None,
     ) -> None:
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -1075,6 +1151,17 @@ class BatchedDecodeEngine:
         self.mode, self.mesh_cfg, self._n_kv, _ = _select_mode(
             cfg, mesh_cfg, entry="BatchedDecodeEngine", allow_zero3=False
         )
+        self.device = _resolve_device(device)
+        if self.device is not None and self.mode != "plain":
+            raise ValueError(
+                "device= pins the single-device (plain) engine to one "
+                "chip; meshed modes place via MeshConfig.device_ids"
+            )
+        # Disaggregation role: the dense engine always runs colocated
+        # (KV handoff ships PAGES — PagedBatchedDecodeEngine overrides
+        # this with its role= knob); the attribute exists here so the
+        # uniform stats() schema carries one key set for every engine.
+        self.role = "colocated"
         # Per-row speculative decoding (batched prompt-lookup — ROADMAP
         # direction 3): with speculative_k=K > 0 every decode tick
         # drafts up to K tokens per GREEDY row host-side (zero model
@@ -1215,9 +1302,15 @@ class BatchedDecodeEngine:
                 is_leaf=lambda x: isinstance(x, P),
             )
             return jax.device_put(full, sharding)
-        return decode.init_cache(
+        cache = decode.init_cache(
             self.cfg, self.slots, self.max_len, n_kv=self._n_kv
         )
+        if self.device is not None:
+            # Committed inputs pin every jitted program's outputs to
+            # the same chip — one device_put per cache alloc places the
+            # engine's whole steady-state compute.
+            cache = jax.device_put(cache, self.device)
+        return cache
 
     def _take_cache(self) -> decode.Cache:
         cache, self._cache = self._cache, None
@@ -1437,7 +1530,8 @@ class BatchedDecodeEngine:
         return prog
 
     def _place_params(self, params):
-        if self.mode == "plain" and self.weight_quant == "none":
+        if (self.mode == "plain" and self.weight_quant == "none"
+                and self.device is None):
             return params
         if self._placed is None or self._placed[0] is not params:
             prepared = (
@@ -1447,8 +1541,18 @@ class BatchedDecodeEngine:
             )
             if self.mode != "plain":
                 prepared = jax.device_put(prepared, self._param_shardings)
+            elif self.device is not None:
+                prepared = jax.device_put(prepared, self.device)
             self._placed = (params, prepared)
         return self._placed[1]
+
+    def device_ids(self) -> list[int]:
+        """Process-local device ids this engine's programs run on —
+        ``stats()``'s placement figure (see DecodeEngine.device_ids)."""
+        if self.mode == "plain":
+            d = self.device if self.device is not None else jax.devices()[0]
+            return [d.id]
+        return [d.id for d in self._mesh.devices.flat]
 
     # -- request API -------------------------------------------------------
 
@@ -2454,6 +2558,8 @@ class BatchedDecodeEngine:
             by_tier[TIER_NAME[q.tier]] += 1
         return {
             "engine": type(self).__name__,
+            "role": self.role,
+            "device_ids": self.device_ids(),
             "queue_depth": len(self._queue),
             "queue_depth_by_tier": by_tier,
             "slots": self.slots,
@@ -2599,6 +2705,34 @@ class _PagedSlot(_Slot):
         return self.pos >= self.prefill_len
 
 
+@dataclasses.dataclass
+class KVHandoff:
+    """One finished prefill leaving a PREFILL worker (disaggregated
+    serving): the device pages (+ block-table order, + per-row int8
+    scale leaves riding the same tree) and every host field a decode
+    worker needs to continue the row BIT-IDENTICALLY to a colocated
+    run. ``entry`` doubles as the fault fallback: it is the ordinary
+    PR-6 resume entry for the same row, so a handoff that never
+    completes (either side dying) degrades to the existing
+    resume/failover path with zero new machinery."""
+
+    entry: Any            # _Pending resume entry (fault fallback + host fields)
+    pages: Any            # device tree, per leaf [L, max_pages, ...]
+    n_pages: int          # real (non-padding) table entries
+    pos: int              # committed depth (== prefill_len on export)
+    fold: int             # the row's PRNG fold cursor
+    generated: list       # resume gen + the final-chunk sampled token
+    prefill_len: int
+    resume_base: int
+    page_size: int
+    max_pages: int
+    kv_quant: str
+    src_rid: int          # engine-local rid on the SOURCE engine
+    useful_bytes: int     # n_pages x page_size x bytes/position
+    wire_bytes: int       # padded tree bytes actually shipped
+    export_s: float       # device time of the kv_export gather
+
+
 class PagedBatchedDecodeEngine(BatchedDecodeEngine):
     """Continuous batching over a PAGED KV cache: the block-pool refactor
     of ``BatchedDecodeEngine`` (ROADMAP direction 1 — the vLLM move).
@@ -2669,7 +2803,16 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
     surface).
     """
 
-    CACHE_ARGNUM = {"prefill": 5, "decode_step": 2, "decode_spec_step": 2}
+    # kv_import is the ONLY kv-handoff program that donates: it scatters
+    # imported pages into this worker's pool in place. kv_export is a
+    # pure gather and deliberately does NOT donate (the source pool must
+    # stay valid until the router confirms the import landed — see
+    # ``export_handoff``), so it has no entry here. Its argnums count
+    # the program's own operands (kv programs take no params).
+    CACHE_ARGNUM = {
+        "prefill": 5, "decode_step": 2, "decode_spec_step": 2,
+        "kv_import": 2,
+    }
 
     def __init__(
         self,
@@ -2685,6 +2828,7 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         mesh_cfg: MeshConfig | None = None,
         session_pin_budget_pages: int | None = None,
         batch_admit_free_frac: float = 0.25,
+        role: str = "colocated",
         **kw,
     ) -> None:
         if page_size < 1 or max_len % page_size:
@@ -2752,9 +2896,17 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             )
         self._paged_impl = paged_attention
         self.kv_quant = _check_quant_arg("kv_quant", kv_quant)
+        # Disaggregation role (ROADMAP direction 1): "colocated" is the
+        # historic engine (prefill + decode on one worker); "prefill"
+        # runs chunked prefill only and parks finished rows for
+        # ``export_handoff``; "decode" accepts rows only via
+        # ``import_handoff``/``adopt`` and never prefills fresh prompts.
+        self.role = _check_role(role)
         self.counters["preemptions"] = 0
         self.counters["preempt_priority"] = 0
         self.counters["batch_yield_ticks"] = 0
+        self.counters["handoffs_out"] = 0
+        self.counters["handoffs_in"] = 0
         if not 0.0 <= batch_admit_free_frac <= 1.0:
             raise ValueError(
                 f"batch_admit_free_frac must be in [0, 1], got "
@@ -2824,10 +2976,15 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 is_leaf=lambda x: isinstance(x, P),
             )
             return jax.device_put(full, sharding)
-        return decode.init_paged_cache(
+        cache = decode.init_paged_cache(
             self.cfg, self.pool_pages, self.page_size, n_kv=self._n_kv,
             kv_quant=self.kv_quant,
         )
+        if self.device is not None:
+            # Committed inputs pin every jitted program's outputs to the
+            # same chip (see the dense engine's _new_cache).
+            cache = jax.device_put(cache, self.device)
+        return cache
 
     def _bytes_per_position(self) -> int:
         return _kv_bytes_per_position(self.cfg, self.kv_quant)
@@ -2949,13 +3106,45 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             "decode_spec_step": decode_spec_step,
         }
 
+    def _kv_bodies(self):
+        """The two kv-handoff program bodies (disaggregated serving):
+        params-free page movers, generic over the cache tree so int8
+        pools ship their per-token scale leaves alongside the values.
+        Padded table entries are 0, so export gathers (and import
+        scatters) scratch-page garbage on the unused lanes —
+        garbage-by-design, exactly like a free row's decode lane."""
+
+        def kv_export(cache, table):
+            # [L, pool_pages, ...] -> [L, max_pages, ...] per leaf: one
+            # row's pages in table order. NOT donated — the source pool
+            # stays live until the handoff is confirmed complete.
+            return {kk: vv[:, table] for kk, vv in cache.items()}
+
+        def kv_import(pages, table, cache):
+            # Scatter one exported row into this pool at the freshly
+            # allocated page ids (donates the pool — in-place scatter).
+            # table duplicates (the 0-padding) overlap-write only the
+            # scratch page.
+            return {
+                kk: cache[kk].at[:, table].set(pages[kk]) for kk in cache
+            }
+
+        return {"kv_export": kv_export, "kv_import": kv_import}
+
+    def _check_program_kind(self, kind: str) -> None:
+        if kind in _KV_PROGRAM_KINDS:
+            return
+        super()._check_program_kind(kind)
+
     def program(self, kind: str):
         self._check_program_kind(kind)
         prog = self._programs.get(kind)
         if prog is not None:
             return prog
-        body = self._bodies()[kind]
-        donate = (self.CACHE_ARGNUM[kind],)
+        kv = kind in _KV_PROGRAM_KINDS
+        body = self._kv_bodies()[kind] if kv else self._bodies()[kind]
+        ca = self.CACHE_ARGNUM.get(kind)
+        donate = () if ca is None else (ca,)
         if self.mode == "plain":
             prog = jax.jit(body, donate_argnums=donate)
         else:  # tp: head-sharded page pool, everything else replicated
@@ -2977,12 +3166,21 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                     self._p_specs, P(), cache_spec, P(), P(), P(),
                     P(), P(), P(), P(), P(), P(),
                 ),
-            }[kind] + self._lora_in_specs()
-            out_specs = (
-                (P(), P(), P(), cache_spec)
-                if kind == "decode_spec_step"
-                else (P(), P(), cache_spec)
-            )
+                # Pages ship head-sharded exactly like the pool they
+                # came from / land in: each TP shard moves its own
+                # slice, no collectives (NO_COLLECTIVES-pinned in the
+                # audit registry). No LoRA operands — kv programs are
+                # params-free.
+                "kv_export": (cache_spec, P()),
+                "kv_import": (cache_spec, P(), cache_spec),
+            }[kind]
+            if not kv:
+                specs = specs + self._lora_in_specs()
+            out_specs = {
+                "decode_spec_step": (P(), P(), P(), cache_spec),
+                "kv_export": cache_spec,
+                "kv_import": cache_spec,
+            }.get(kind, (P(), P(), cache_spec))
             smapped = shard_map(
                 body,
                 mesh=self._mesh,
@@ -3342,6 +3540,19 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             s.pos += v
             if s.pos >= s.prefill_len:
                 s.generated.append(int(toks[j]))
+                if self.role == "prefill":
+                    # The row is now handoff-eligible: it parks here
+                    # (pages held) until the router pumps it to a decode
+                    # worker. bytes = the pages a handoff will ship.
+                    log_event(
+                        "prefill_done", rid=s.rid,
+                        prompt_len=s.prefill_len, pages=s.n_pages,
+                        bytes=(
+                            s.n_pages * self.page_size
+                            * self._bytes_per_position()
+                        ),
+                        t=round(self._clock(), 6),
+                    )
                 self._maybe_retire(row, finished)
 
     def _grow_for_drafts(self, s: _PagedSlot, n: int) -> int:
@@ -3439,6 +3650,12 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
             )
 
     def _decode_tick(self, params, finished: list[int]) -> None:
+        if self.role == "prefill":
+            # A PREFILL worker never decodes: finished-prefill rows park
+            # (ready, pages held) until the router's handoff pump ships
+            # them to a decode worker (``export_handoff``). _maybe_retire
+            # already retired any max_new==1 row at its final chunk.
+            return
         if self.speculative_k:
             return self._decode_tick_spec(params, finished)
         # BATCH decode yields to a live interactive row (the decode
@@ -3635,7 +3852,10 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
     def warmup(self, params) -> int:
         """Compile every prefill group shape plus the decode step (the
         whole steady-state compile set: chunked prefill has ONE token
-        shape, so there is no bucket dimension to cover)."""
+        shape, so there is no bucket dimension to cover). Disaggregated
+        roles additionally warm their side of the kv-handoff pair —
+        export on PREFILL workers, import on DECODE workers — so a
+        steady-state handoff compiles nothing."""
         if self.has_work():
             raise RuntimeError("warmup requires an idle engine")
         params = self._place_params(params)
@@ -3652,6 +3872,32 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
         )
         *_, cache = self.program(step_kind)(*args)
         self._cache = cache
+        if self.role == "prefill":
+            cache, table = self.example_args(
+                "kv_export", params, cache=self._take_cache()
+            )
+            jax.block_until_ready(self.program("kv_export")(cache, table))
+            self._cache = cache  # export does not donate
+        elif self.role == "decode":
+            # Twice, threading the output back in: the first call's
+            # donated pool is a decode_step OUTPUT, but every steady
+            # import consumes a previous import's output — whose layout
+            # can hash differently (the _rewarm_first_prefill trick for
+            # the handoff path; pinned by the disagg compile tests).
+            for _ in range(2):
+                pages, table, cache = self.example_args(
+                    "kv_import", params, cache=self._take_cache()
+                )
+                self._cache = self.program("kv_import")(
+                    self._place_handoff_pages(pages), table, cache
+                )
+            # The first decode tick after an import consumes the
+            # import's output pool — cover THAT input layout too.
+            args = self.example_args(
+                step_kind, params, cache=self._take_cache()
+            )
+            *_, cache = self.program(step_kind)(*args)
+            self._cache = cache
         return self.compile_count()
 
     def example_args(self, kind: str, params, *, bucket: int | None = None,
@@ -3708,7 +3954,215 @@ class PagedBatchedDecodeEngine(BatchedDecodeEngine):
                 jnp.zeros((b, self._key_words), jnp.uint32),
                 jnp.zeros((b,), jnp.int32),
             ) + self._lora_dispatch_args(np.zeros((b,), np.int32))
+        if kind == "kv_export":
+            # kv programs are params-free: ``params`` is accepted (and
+            # ignored) for signature parity with every other kind.
+            return (cache, jnp.zeros((mp,), jnp.int32))
+        if kind == "kv_import":
+            pages = {
+                kk: jnp.zeros(
+                    (vv.shape[0], mp) + tuple(vv.shape[2:]), vv.dtype
+                )
+                for kk, vv in cache.items()
+            }
+            return (pages, jnp.zeros((mp,), jnp.int32), cache)
         raise KeyError(f"unknown batched program kind {kind!r}")
+
+    # -- disaggregation: kv handoff ----------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, **kw) -> int:
+        if self.role == "decode":
+            raise ValueError(
+                "this engine is a DECODE worker: it accepts rows only "
+                "via import_handoff (finished prefills) or adopt "
+                "(failover resume entries) — route fresh prompts to a "
+                "prefill or colocated worker"
+            )
+        return super().submit(prompt, max_new_tokens, **kw)
+
+    def handoff_ready(self) -> list[int]:
+        """Engine rids of rows parked on this PREFILL worker with their
+        prefill finished — the rows ``export_handoff`` can ship. Empty
+        on every other role (colocated rows decode in place)."""
+        if self.role != "prefill":
+            return []
+        return [
+            s.rid for s in self._slots
+            if s is not None and s.ready
+        ]
+
+    def export_handoff(self, rid: int) -> KVHandoff:
+        """Gather one parked row's KV pages off the pool (kv_export —
+        warmed, zero steady-state compiles) and package everything a
+        decode worker needs to continue it bit-identically. READ-ONLY:
+        the row stays live (pages held, fault model intact) until
+        ``complete_handoff`` confirms the import landed — a destination
+        dying mid-handoff costs nothing but the gather."""
+        s = next(
+            (x for x in self._slots if x is not None and x.rid == rid),
+            None,
+        )
+        if s is None:
+            raise KeyError(f"no active row with rid {rid} to hand off")
+        if not s.ready:
+            raise ValueError(
+                f"rid {rid} is mid-prefill (pos {s.pos} < "
+                f"{s.prefill_len}) — only finished prefills hand off"
+            )
+        t0 = time.perf_counter()
+        cache = self._take_cache()
+        pages = self.program("kv_export")(cache, jnp.asarray(s.table))
+        self._cache = cache  # not donated: the pool buffer stays valid
+        jax.block_until_ready(pages)
+        export_s = time.perf_counter() - t0
+        wire = sum(
+            v.size * v.dtype.itemsize for v in jax.tree.leaves(pages)
+        )
+        return KVHandoff(
+            entry=self._pending_from_slot(s, bump=False),
+            pages=pages, n_pages=s.n_pages, pos=s.pos, fold=s.fold,
+            generated=list(s.generated), prefill_len=s.prefill_len,
+            resume_base=s.resume_base, page_size=self.page_size,
+            max_pages=self.max_pages, kv_quant=self.kv_quant,
+            src_rid=s.rid,
+            useful_bytes=(
+                s.n_pages * self.page_size * self._bytes_per_position()
+            ),
+            wire_bytes=int(wire), export_s=export_s,
+        )
+
+    def complete_handoff(self, rid: int) -> None:
+        """The destination confirmed the import: release the source
+        row WITHOUT a terminal result — ownership (and the client's
+        rid mapping, which the router owns) moved to the destination
+        engine. The freed pages go back to this worker's pool."""
+        for i, s in enumerate(self._slots):
+            if s is not None and s.rid == rid:
+                self._slots[i] = None
+                self._on_slot_freed(s)
+                self.pool.note_handoff_out(s.n_pages)
+                self.counters["handoffs_out"] += 1
+                return
+        raise KeyError(f"no active row with rid {rid} to complete")
+
+    def can_import_handoff(self, h: KVHandoff) -> bool:
+        """Cheap host-side gate the router's handoff pump scores
+        targets with: a free slot row plus allocatable pool headroom
+        for the row's pages (LRU-evictable cached prefixes count —
+        they are reclaimable, not pressure)."""
+        return (
+            self.role != "prefill"
+            and any(s is None for s in self._slots)
+            and self.pool.allocatable_pages() >= h.n_pages
+            and h.page_size == self.page_size
+            and h.max_pages == self.max_pages
+            and h.kv_quant == self.kv_quant
+        )
+
+    def _place_handoff_pages(self, pages):
+        """Commit an exported pages tree to THIS engine's placement:
+        the wire hop of the handoff. The source committed the tree to
+        ITS device(s); re-committing keeps every kv_import operand on
+        one placement (and keeps the import's compiled signature
+        identical to the one ``warmup`` built — a sharding-hash
+        mismatch here would be a steady-state compile)."""
+        if self.mode == "tp":
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharding = jax.tree.map(
+                lambda sp: NamedSharding(self._mesh, sp),
+                self._cache_pspec(),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            return jax.device_put(pages, sharding)
+        dev = self.device if self.device is not None else jax.devices()[0]
+        return jax.device_put(pages, dev)
+
+    def import_handoff(
+        self, h: KVHandoff, finished: list[int] | None = None
+    ) -> int | None:
+        """Land one exported row in this worker's pool (kv_import —
+        donated in-place scatter, warmed on DECODE workers) and seat it
+        as a decode-ready slot under a fresh local rid. Returns the new
+        rid, or None when the import could not land (no headroom, or
+        the scatter dispatch failed and was RECOVERED — pool reset,
+        in-flight rows converted to resume entries exactly like any
+        failed dispatch; terminal rids from that recovery land in
+        ``finished``). The source row is untouched either way until
+        ``complete_handoff``."""
+        if self.role == "prefill":
+            raise ValueError(
+                "a PREFILL worker cannot import handoffs — it only "
+                "exports them"
+            )
+        if (
+            h.page_size != self.page_size
+            or h.max_pages != self.max_pages
+            or h.kv_quant != self.kv_quant
+        ):
+            raise ValueError(
+                "kv_handoff geometry mismatch: source pages are "
+                f"(page_size={h.page_size}, max_pages={h.max_pages}, "
+                f"kv_quant={h.kv_quant!r}) but this engine is "
+                f"(page_size={self.page_size}, max_pages="
+                f"{self.max_pages}, kv_quant={self.kv_quant!r}) — "
+                "disaggregated fleets must share the page geometry"
+            )
+        q = h.entry
+        if len(q.prompt) + q.max_new > self.max_len:
+            raise ValueError(
+                f"handed-off entry needs {len(q.prompt) + q.max_new} "
+                f"cache positions but this engine's max_len is "
+                f"{self.max_len}"
+            )
+        row = next(
+            (i for i, s in enumerate(self._slots) if s is None), None
+        )
+        if row is None:
+            return None
+        pids = self.pool.alloc_for_handoff(h.n_pages)
+        if pids is None:
+            return None
+        table = np.zeros((self.max_pages,), np.int32)
+        table[: h.n_pages] = pids
+        pages = self._place_handoff_pages(h.pages)
+        try:
+            cache = self.program("kv_import")(
+                pages, jnp.asarray(table), self._take_cache()
+            )
+        except Exception as err:
+            # The donated pool was consumed with the failed scatter:
+            # same recovery as any failed dispatch (pool reset, rows to
+            # resume entries). May raise DispatchFailure past the
+            # streak budget — the router treats that as replica death.
+            self.pool.release(pids)
+            self._recover_dispatch_failure(
+                "kv_import", err, [],
+                finished if finished is not None else [],
+            )
+            return None
+        self._cache = cache
+        rid = self._next_rid
+        self._next_rid += 1
+        self._slots[row] = _PagedSlot(
+            rid=rid, prompt=q.prompt, max_new=q.max_new, eos_id=q.eos_id,
+            pos=h.pos, fold=h.fold, generated=list(h.generated),
+            greedy=q.greedy, t=q.t, k=q.k, p=q.p, keydata=q.keydata,
+            deadline=q.deadline, retries=q.retries,
+            nan_retried=q.nan_retried, tier=q.tier,
+            # Sessions are engine-local (pinned pages live on the
+            # source); a handed-off turn finishes as a plain request,
+            # exactly like adopt().
+            session=None, resub_len=0, tenant_slot=q.tenant_slot,
+            prefix=self._partial_tokens(
+                q.prompt, list(q.gen)[: h.resume_base]
+            ),
+            prefill_len=h.prefill_len, table=table, pids=list(pids),
+            n_pages=h.n_pages, prefill_keydata=q.prefill_keydata,
+            resume_base=h.resume_base, chain_key="",
+        )
+        self.counters["handoffs_in"] += 1
+        return rid
 
 
 @functools.lru_cache(maxsize=None)

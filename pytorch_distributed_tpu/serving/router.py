@@ -78,6 +78,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable
 
 import numpy as np
@@ -134,6 +135,15 @@ class ReplicaRouter:
     - ``retry_after_s``: the shed hint when the drain estimate has no
       signal (fleet fully down); otherwise the estimate is derived from
       the median step EMA and the shallowest queue.
+    - ``parallel_step``: step busy replicas concurrently (one host
+      thread per replica) instead of round-robin. With per-replica
+      device placement (``MeshConfig.device_ids`` / engine ``device=``)
+      the replicas' XLA dispatches overlap on disjoint device slices —
+      the wall-clock win scripts/loadgen.py measures. Engine ticks stay
+      single-threaded per engine; all router bookkeeping (health,
+      delivery, failover, handoffs) runs serially after the joins, so
+      determinism contracts are untouched. Default False: virtual-clock
+      tests and chaos schedules assume sequential stepping.
     """
 
     def __init__(
@@ -148,6 +158,7 @@ class ReplicaRouter:
         degrade_min_s: float = 0.05,
         ema_alpha: float = 0.3,
         retry_after_s: float = 1.0,
+        parallel_step: bool = False,
     ) -> None:
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
@@ -157,12 +168,15 @@ class ReplicaRouter:
             _Replica(rep_id=i, engine=make_engine(i))
             for i in range(n_replicas)
         ]
+        for r in self._replicas:
+            self._log_role(r)
         self.shed_queue_depth = shed_queue_depth
         self.shed_page_free = int(shed_page_free)
         self.degrade_factor = float(degrade_factor)
         self.degrade_min_s = float(degrade_min_s)
         self.ema_alpha = float(ema_alpha)
         self.retry_after_s = float(retry_after_s)
+        self.parallel_step = bool(parallel_step)
         self._next_rid = 0
         # router rid -> (rep_id, engine rid); the mirror of each
         # replica's rid_map. Entries leave on terminal delivery.
@@ -184,9 +198,26 @@ class ReplicaRouter:
             "routed": 0, "shed": 0, "failovers": 0, "failover_requests": 0,
             "drains": 0, "restarts": 0, "orphaned": 0,
             "sessions_opened": 0, "session_rehomes": 0,
+            "handoffs": 0,
         }
 
     # -- fleet management ---------------------------------------------------
+
+    @staticmethod
+    def _role(r: _Replica) -> str:
+        """The replica's disaggregation role. Engines without the knob
+        (dense engines, pre-disagg paged builds) are colocated."""
+        return getattr(r.engine, "role", "colocated")
+
+    def _log_role(self, r: _Replica) -> None:
+        log_event(
+            "role_assign", replica=r.rep_id, role=self._role(r),
+            device_ids=(
+                r.engine.device_ids()
+                if hasattr(r.engine, "device_ids") else None
+            ),
+            t=round(self._clock(), 6),
+        )
 
     def warmup(self, params) -> int:
         """Warm every replica's compile set and record the per-replica
@@ -224,7 +255,12 @@ class ReplicaRouter:
         """Admission + scoring in one read of the replica's uniform
         ``stats()``: None = not admissible (saturated queue or page
         starvation); otherwise the routing sort key — DEGRADED after
-        HEALTHY, then least host load, then page pressure, then id."""
+        HEALTHY, then least host load, then page pressure, then id.
+        DECODE workers are never admissible: fresh prompts are prefill
+        work and reach them only as kv handoffs (regression-pinned in
+        tests/test_serving_disagg.py)."""
+        if self._role(r) == "decode":
+            return None
         st = r.engine.stats()
         limit = (
             self.shed_queue_depth
@@ -298,11 +334,13 @@ class ReplicaRouter:
         takes. The router owns the sid -> (replica, engine sid)
         stickiness map and re-homes the session to a survivor on
         replica loss."""
-        best = self._least_loaded()
+        best = self._least_loaded(colocated_only=True)
         if best is None:
             raise RouterOverloaded(
-                "no live replica to open a session on "
-                f"(states {self.replica_states()})",
+                "no live colocated replica to open a session on — "
+                "sessions pin prefix pages where their turns both "
+                "prefill AND decode, so prefill/decode workers cannot "
+                f"host them (states {self.replica_states()})",
                 retry_after_s=self._retry_after(),
             )
         if not hasattr(best.engine, "open_session"):
@@ -354,7 +392,7 @@ class ReplicaRouter:
         r = self._replicas[rep_id]
         if r.state in _ROUTABLE:
             return r, esid
-        best = self._least_loaded()
+        best = self._least_loaded(colocated_only=True)
         if best is None:
             raise RouterOverloaded(
                 f"session {sid}'s replica {rep_id} is {r.state} and no "
@@ -554,35 +592,154 @@ class ReplicaRouter:
                 self.kill(target, reason="chaos replica_kill")
         self._readopt_orphans()
         finished: list[int] = []
-        for r in self._replicas:
-            if r.state not in _ROUTABLE:
-                continue
-            if not r.engine.has_work():
-                # An idle DEGRADED replica would stay deprioritized
-                # forever (no ticks -> no EMA evidence): decay its EMA
-                # optimistically instead — DEGRADED only deprioritizes,
-                # so a premature recovery costs one slow tick, not an
-                # outage.
-                if r.state == DEGRADED:
-                    self._update_health(r, 0.0)
-                continue
+
+        def _idle(r: _Replica) -> bool:
+            if r.engine.has_work():
+                return False
+            # An idle DEGRADED replica would stay deprioritized
+            # forever (no ticks -> no EMA evidence): decay its EMA
+            # optimistically instead — DEGRADED only deprioritizes,
+            # so a premature recovery costs one slow tick, not an
+            # outage.
+            if r.state == DEGRADED:
+                self._update_health(r, 0.0)
+            return True
+
+        def _one(r: _Replica):
             t0 = self._clock()
             try:
                 done = r.engine.step(params)
             except DispatchFailure as err:
+                return r, self._clock() - t0, None, err
+            return r, self._clock() - t0, done, None
+
+        def _settle(r: _Replica, dt: float, done, err) -> None:
+            if err is not None:
                 # The engine exhausted its own retry budget and left its
                 # state consistent (everything requeued) — at the router
                 # tier that IS replica death; survivors take the work.
                 self._take_down(
                     r, f"dispatch failure: {err}", finished=finished
                 )
-                continue
-            self._update_health(r, self._clock() - t0)
+                return
+            self._update_health(r, dt)
             for erid in done:
                 finished.append(
                     self._deliver(r, erid, r.engine.pop_result(erid))
                 )
+
+        if self.parallel_step:
+            busy = [
+                r for r in self._replicas
+                if r.state in _ROUTABLE and not _idle(r)
+            ]
+            if len(busy) > 1:
+                # Each replica's dispatch overlaps on its own device
+                # slice; everything mutable at router scope waits for
+                # the joins.
+                with ThreadPoolExecutor(max_workers=len(busy)) as pool:
+                    stepped = list(pool.map(_one, busy))
+            else:
+                stepped = [_one(r) for r in busy]
+            for r, dt, done, err in stepped:
+                _settle(r, dt, done, err)
+        else:
+            # Settle inline, re-reading routability and has_work at each
+            # replica's turn: a mid-tick death's failover entries can be
+            # adopted — and stepped — by replicas LATER this same tick.
+            for r in self._replicas:
+                if r.state not in _ROUTABLE or _idle(r):
+                    continue
+                _settle(*_one(r))
+        self._pump_handoffs(finished)
         return finished
+
+    # -- disaggregation: kv handoff pump ------------------------------------
+
+    def _handoff_target(self, h) -> _Replica | None:
+        """Best routable replica to continue a finished prefill: never a
+        PREFILL worker (the role pin's other direction — decode work
+        does not route to prefill-only replicas), must pass the
+        engine-side geometry/capacity gate (``can_import_handoff``),
+        preferring HEALTHY then lowest page pressure (pages are what a
+        handoff consumes) then lightest host load, id tie-break."""
+        best, best_key = None, None
+        for r in self._replicas:
+            if r.state not in _ROUTABLE or self._role(r) == "prefill":
+                continue
+            eng = r.engine
+            if not (hasattr(eng, "can_import_handoff")
+                    and eng.can_import_handoff(h)):
+                continue
+            st = eng.stats()
+            pinned = st.get("session_pinned_pages") or 0
+            pressure = (
+                (st["pages_in_use"] + pinned) / max(1, st["pool_pages"])
+                if st.get("free_pages") is not None else 0.0
+            )
+            key = (
+                1.0 if r.state == DEGRADED else 0.0,
+                pressure,
+                float(st["queue_depth"] + st["active_rows"]),
+                float(r.rep_id),
+            )
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        return best
+
+    def _pump_handoffs(self, finished: list[int]) -> None:
+        """Move every finished prefill off its PREFILL worker onto a
+        decode-capable replica. Source rows stay live (resume-entry
+        fallback) until ``complete_handoff`` — a crash on either side
+        mid-handoff degrades to the ordinary failover path, never to a
+        lost or duplicated rid. No target this tick just parks the row;
+        it is retried next tick (prefill workers park ready rows
+        rather than decoding them)."""
+        for src in self._replicas:
+            if src.state not in _ROUTABLE or self._role(src) != "prefill":
+                continue
+            seng = src.engine
+            for erid in list(seng.handoff_ready()):
+                t0 = self._clock()
+                h = seng.export_handoff(erid)
+                dst = self._handoff_target(h)
+                if dst is None:
+                    continue
+                eng_fin: list[int] = []
+                try:
+                    new_erid = dst.engine.import_handoff(h, eng_fin)
+                except DispatchFailure as err:
+                    # _take_down snapshots the destination and delivers
+                    # EVERY undelivered result — including rows the
+                    # failed import's recovery terminally FAILED — so
+                    # eng_fin must not be delivered again here.
+                    self._take_down(
+                        dst, f"kv_import dispatch failure: {err}",
+                        finished=finished,
+                    )
+                    continue
+                # Recovery inside a survivable failed import can
+                # terminally FAIL rows on the destination (retry budget
+                # exhausted) — deliver them like step() would.
+                for fe in eng_fin:
+                    finished.append(self._deliver(
+                        dst, fe, dst.engine.pop_result(fe)
+                    ))
+                if new_erid is None:
+                    continue  # no row/pages after all — retry next tick
+                rid = src.rid_map.pop(erid)
+                dst.rid_map[new_erid] = rid
+                self._assign[rid] = (dst.rep_id, new_erid)
+                seng.complete_handoff(erid)
+                self.counters["handoffs"] += 1
+                log_event(
+                    "kv_handoff", rid=rid, from_replica=src.rep_id,
+                    to_replica=dst.rep_id, pages=h.n_pages,
+                    bytes=h.wire_bytes, useful_bytes=h.useful_bytes,
+                    export_s=round(h.export_s, 6),
+                    latency_s=round(self._clock() - t0, 6),
+                    t=round(self._clock(), 6),
+                )
 
     def run(self, params, *, max_ticks: int | None = None) -> list[int]:
         """Drive ``step`` until idle (or ``max_ticks``); returns every
@@ -664,15 +821,23 @@ class ReplicaRouter:
         self._redistribute(r, snap.pending)
         r.rid_map.clear()
 
-    def _least_loaded(self, exclude: _Replica | None = None):
+    def _least_loaded(self, exclude: _Replica | None = None, *,
+                      colocated_only: bool = False):
         """Least-loaded routable replica for failover/re-adoption —
         same preference order as routing (HEALTHY before DEGRADED, then
         host load, then id) but WITHOUT the admission thresholds:
         failover must not shed accepted work, and engine-side deferral
-        (page starvation) already degrades gracefully."""
+        (page starvation) already degrades gracefully. DECODE workers
+        are never candidates (a resume entry is re-PREFILL work — the
+        decode-ward regression pin's mirror); ``colocated_only``
+        additionally excludes PREFILL workers (sessions must live where
+        their turns both prefill AND decode)."""
         best, best_key = None, None
         for r in self._replicas:
             if r is exclude or r.state not in _ROUTABLE:
+                continue
+            role = self._role(r)
+            if role == "decode" or (colocated_only and role != "colocated"):
                 continue
             st = r.engine.stats()
             key = (
@@ -785,6 +950,7 @@ class ReplicaRouter:
             # stale engine-rid mappings died with the old engine.
             r.rid_map.clear()
         r.engine = self._make_engine(rep_id)
+        self._log_role(r)
         r.engine.warmup(params)
         if r.held_snapshot is not None:
             r.engine.restore(r.held_snapshot)

@@ -173,6 +173,61 @@ def tiered_stream(
     return [r for *_, r in sorted(tagged, key=lambda e: e[:3])]
 
 
+def disagg_stream(
+    seed: int,
+    *,
+    n: int,
+    vocab_size: int,
+    p_heavy_prefill: float = 0.5,
+    heavy_prompt_len: tuple[int, int] = (96, 160),
+    heavy_max_new: tuple[int, int] = (4, 8),
+    light_prompt_len: tuple[int, int] = (8, 24),
+    light_max_new: tuple[int, int] = (24, 48),
+    sampling_cycle=DEFAULT_SAMPLING_CYCLE,
+) -> list[dict]:
+    """The disaggregation workload: a seeded mix of the two shapes
+    whose INTERFERENCE prefill/decode separation exists to remove —
+    ``heavy_prefill`` rows (long prompt, short decode: the chunked
+    prefill that stalls a colocated engine's decode ticks) and
+    ``light`` rows (short prompt, long decode: the interactive traffic
+    whose inter-token p99 that stall inflates). Each dict is a
+    ``submit`` kwarg set plus a ``"kind"`` tag ("heavy_prefill" /
+    "light") the driver pops before submitting — the bench classifies
+    its latency percentiles by it.
+
+    Request ``i``'s content (class draw, lengths, tokens, deadline-free
+    sampling config) derives from ``(seed, i)`` ALONE — its own
+    ``default_rng([crc32("disagg"), seed, i])`` substream plus the
+    ``fold_in(key(seed), i)`` sampling key — so truncating, extending,
+    or re-partitioning the stream never perturbs any other request:
+    colocated and disaggregated legs replay request-for-request
+    identical content whatever fleet serves them."""
+    import zlib
+
+    import jax
+
+    base_key = None
+    reqs: list[dict] = []
+    for i in range(n):
+        sub = np.random.default_rng([zlib.crc32(b"disagg"), seed, i])
+        heavy = bool(sub.random() < p_heavy_prefill)
+        lo, hi = heavy_prompt_len if heavy else light_prompt_len
+        tp = int(sub.integers(lo, hi + 1))
+        prompt = sub.integers(0, vocab_size, (tp,)).astype(np.int32)
+        mlo, mhi = heavy_max_new if heavy else light_max_new
+        mn = int(sub.integers(mlo, mhi + 1))
+        kw = dict(sampling_cycle[i % len(sampling_cycle)])
+        if kw.get("temperature"):
+            if base_key is None:
+                base_key = jax.random.key(seed)
+            kw["key"] = jax.random.fold_in(base_key, i)
+        reqs.append(dict(
+            kind="heavy_prefill" if heavy else "light",
+            prompt=prompt, max_new_tokens=mn, **kw,
+        ))
+    return reqs
+
+
 def session_stream(
     rng: np.random.Generator,
     *,

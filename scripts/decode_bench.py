@@ -48,6 +48,16 @@ tok/s >= 0.9x the adapter-less base with every tenant row bit-equal
 its isolated-run reference — all legs zero steady-state compiles.
 Artifact: benchmarks/serving_scenarios_bench.json.
 
+``--serving-disagg`` benchmarks DISAGGREGATED prefill/decode serving:
+a dedicated PREFILL worker runs all chunked prefill and ships finished
+KV state (pages + block tables + per-row scale leaves) to a DECODE
+worker over the router's kv_handoff path, vs a same-size colocated
+fleet on one seeded mixed stream (long-prompt/short-decode pressure
+against short-prompt/long-decode interactive rows). DONE-token
+equality, zero steady compiles, and (full run) interactive p99 <=
+colocated are ASSERTED; handoff bytes/latency are reported from the
+kv_handoff log events. Artifact: benchmarks/serving_disagg_bench.json.
+
 ``--serving-batched --chaos`` adds the ROBUSTNESS leg: the same seeded
 arrival stream replayed twice through the batched engine — once clean,
 once under a SEEDED fault schedule (serving/chaos.py: dispatch failures,
@@ -975,6 +985,302 @@ def bench_serving_paged(args) -> list[dict]:
         "outputs_match": f"{matched}/{n_req}",
         "platform": jax.devices()[0].platform,
     }
+    return [row]
+
+
+def bench_serving_disagg(args) -> list[dict]:
+    """Disaggregated prefill/decode serving vs a colocated fleet of the
+    SAME size on one seeded mixed stream (serving/workload.py
+    ``disagg_stream``): heavy_prefill rows (long prompt, short decode)
+    stall a colocated engine's decode ticks — every tick that runs a
+    prefill chunk is a tick the light rows' next tokens wait behind —
+    while the disaggregated fleet runs ALL chunked prefill on a
+    dedicated PREFILL worker and ships finished KV state (pages + block
+    table + per-row scale leaves) to a DECODE worker over the router's
+    ``kv_handoff`` path.
+
+    Two ``ReplicaRouter`` fleets, two replicas each, each replica
+    pinned to its own device when the host has enough: ``colocated``
+    (both replicas accept and serve whole requests) and ``disagg``
+    (replica 0 role=prefill, replica 1 role=decode). Same requests,
+    same arrival schedule, same per-request keys. ASSERTED (nonzero
+    exit via invariant_failures): DONE tokens bit-equal between legs
+    request-for-request, zero steady-state compiles on every replica
+    of both legs, every handoff's bytes accounted. The headline is
+    ``interactive_p99_ratio`` — disaggregated light-row p99 over
+    colocated, under the same prefill pressure (the committed artifact
+    pins it <= 1.0). Handoff cost is reported from the ``kv_handoff``
+    log events themselves (bytes, export time, end-to-end latency) —
+    the bench doubles as a check that the events fire."""
+    import logging as _logging
+
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_tpu.models import get_model
+    from pytorch_distributed_tpu.serving.engine import (
+        PagedBatchedDecodeEngine,
+    )
+    from pytorch_distributed_tpu.serving.router import ReplicaRouter
+    from pytorch_distributed_tpu.serving.workload import (
+        disagg_stream,
+        exponential_arrivals,
+    )
+    from pytorch_distributed_tpu.utils.prng import domain_key
+
+    cfg = _serving_cfg(args.dryrun)
+    slots = 4 if args.dryrun else 8
+    max_len = 160 if args.dryrun else 384
+    page = 16
+    chunk = 16 if args.dryrun else 32
+    n_req = 16 if args.dryrun else 48
+    seed = args.chaos_seed
+    params = get_model(cfg).init(domain_key(seed, "init"), cfg)
+
+    # The mixed stream: heavy rows prefill for many chunks and decode
+    # briefly; light (interactive) rows prefill in one chunk and decode
+    # for many ticks. Every request's content is a pure function of
+    # (seed, index) — both legs replay identical traffic.
+    stream = disagg_stream(
+        seed, n=n_req, vocab_size=cfg.vocab_size,
+        heavy_prompt_len=(96, 128) if args.dryrun else (192, 288),
+        heavy_max_new=(4, 8),
+        light_prompt_len=(8, 16) if args.dryrun else (8, 24),
+        light_max_new=(16, 24) if args.dryrun else (24, 48),
+    )
+    kinds = [r.pop("kind") for r in stream]
+    requests = stream
+
+    devs = jax.devices()
+    pinned = len(devs) >= 4
+
+    def _fleet(role_of, dev_base):
+        def make_engine(rep_id: int):
+            return PagedBatchedDecodeEngine(
+                cfg, slots=slots, max_len=max_len, page_size=page,
+                prefill_chunk=chunk, role=role_of(rep_id),
+                # Distinct devices per (leg, replica) so the two legs'
+                # fleets never share an accelerator.
+                device=devs[dev_base + rep_id] if pinned else None,
+            )
+        # Interference is the thing under measurement: shedding would
+        # censor the p99, so admission is effectively unbounded and the
+        # queue absorbs the burst.
+        return ReplicaRouter(make_engine, 2, shed_queue_depth=10**6)
+
+    colocated = _fleet(lambda i: "colocated", 0)
+    disagg = _fleet(
+        lambda i: "prefill" if i == 0 else "decode", 2 if pinned else 0
+    )
+    colocated.warmup(params)
+    disagg.warmup(params)
+
+    # One arrival schedule for both legs, saturating enough that heavy
+    # prefill chunks and light decode ticks genuinely contend.
+    t0 = time.perf_counter()
+    probe = colocated.submit(**requests[0])
+    colocated.run(params)
+    colocated.pop_result(probe)
+    per_req_est = time.perf_counter() - t0
+    arrivals = exponential_arrivals(
+        np.random.default_rng(seed + 7), n_req,
+        per_req_est / (2 * slots),
+    )
+
+    # Tap the serving logger: the kv_handoff events ARE the handoff
+    # cost measurement (and their firing is itself an invariant).
+    class _Tap(_logging.Handler):
+        def __init__(self):
+            super().__init__(_logging.DEBUG)
+            self.events: list[dict] = []
+
+        def emit(self, record):
+            msg = record.getMessage()
+            if not msg.startswith("event=kv_handoff"):
+                return
+            self.events.append(dict(
+                kv.split("=", 1) for kv in msg.split(" ")
+            ))
+
+    def drive(router, tap=None):
+        lg = _logging.getLogger("pdtpu.serving")
+        old_level, old_prop = lg.level, lg.propagate
+        if tap is not None:
+            lg.addHandler(tap)
+            lg.setLevel(_logging.DEBUG)
+            # The tap is the only intended consumer: without this the
+            # DEBUG records also propagate to the root pdtpu handler
+            # and flood the bench's stdout.
+            lg.propagate = False
+        try:
+            import heapq
+
+            from pytorch_distributed_tpu.serving.lifecycle import (
+                RouterOverloaded,
+            )
+
+            clock = 0.0
+            # (offer time, seq, request index); a page-starved shed —
+            # the prefill worker's parked rows hold their pages until
+            # the handoff completes, which IS backpressure — re-offers
+            # after the router's Retry-After hint, latency accruing
+            # from the ORIGINAL arrival (both legs share this driver,
+            # so retries cost them identically).
+            offers = [(float(t), i, i) for i, t in enumerate(arrivals)]
+            heapq.heapify(offers)
+            seq = n_req
+            rid_to_idx: dict[int, int] = {}
+            lat: dict[int, float] = {}
+            while offers or router.has_work():
+                while offers and offers[0][0] <= clock:
+                    _, _, i = heapq.heappop(offers)
+                    try:
+                        rid = router.submit(**requests[i])
+                        rid_to_idx[rid] = i
+                    except RouterOverloaded as err:
+                        seq += 1
+                        heapq.heappush(offers, (
+                            clock + (err.retry_after_s or 0.1), seq, i,
+                        ))
+                if not router.has_work():
+                    if not offers:
+                        break
+                    clock = max(clock, offers[0][0])
+                    continue
+                t0 = time.perf_counter()
+                done = router.step(params)
+                clock += time.perf_counter() - t0
+                for rid in done:
+                    lat[rid_to_idx[rid]] = clock - arrivals[rid_to_idx[rid]]
+            results = {
+                rid_to_idx[rid]: router.pop_result(rid)
+                for rid in list(router.results)
+            }
+            return clock - arrivals[0], lat, results
+        finally:
+            if tap is not None:
+                lg.removeHandler(tap)
+                lg.setLevel(old_level)
+                lg.propagate = old_prop
+
+    c_span, c_lat, c_results = drive(colocated)
+    tap = _Tap()
+    d_span, d_lat, d_results = drive(disagg, tap)
+
+    failures: list[str] = []
+    mismatch = [
+        i for i in range(n_req)
+        if not np.array_equal(c_results[i].tokens, d_results[i].tokens)
+    ]
+    if mismatch:
+        failures.append(
+            "disagg DONE tokens diverge from colocated for requests "
+            f"{mismatch[:8]}"
+        )
+    for leg_name, router in (("colocated", colocated), ("disagg", disagg)):
+        steady = router.steady_compiles()
+        if any(steady.values()):
+            failures.append(f"{leg_name} steady-state compiles: {steady}")
+    n_handoffs = disagg.counters["handoffs"]
+    if n_handoffs < n_req:
+        failures.append(
+            f"only {n_handoffs}/{n_req} requests took the kv_handoff "
+            "path (every finished prefill must hand off)"
+        )
+    if len(tap.events) != n_handoffs:
+        failures.append(
+            f"kv_handoff events ({len(tap.events)}) != handoffs counter "
+            f"({n_handoffs})"
+        )
+
+    light = [i for i, k in enumerate(kinds) if k == "light"]
+    heavy = [i for i, k in enumerate(kinds) if k == "heavy_prefill"]
+
+    def _leg(span, lat):
+        def pcts(idx):
+            xs = [lat[i] for i in idx if i in lat]
+            return {
+                "p50_request_ms": round(_pct(xs, 0.50) * 1e3, 2),
+                "p99_request_ms": round(_pct(xs, 0.99) * 1e3, 2),
+            }
+        total = sum(len(r["prompt"]) + r["max_new_tokens"]
+                    for r in requests)
+        gen = sum(r["max_new_tokens"] for r in requests)
+        return {
+            "steady_tokens_per_sec": round(gen / span, 1),
+            "prefill_tokens_per_sec": round((total - gen) / span, 1),
+            "interactive": pcts(light),
+            "heavy_prefill": pcts(heavy),
+        }
+
+    c_row, d_row = _leg(c_span, c_lat), _leg(d_span, d_lat)
+    ratio = (
+        d_row["interactive"]["p99_request_ms"]
+        / max(c_row["interactive"]["p99_request_ms"], 1e-9)
+    )
+    if not args.dryrun and ratio > 1.0:
+        failures.append(
+            "disaggregation did not relieve prefill interference: "
+            f"interactive p99 ratio {ratio:.3f} > 1.0"
+        )
+
+    handoff_bytes = [int(e["bytes"]) for e in tap.events]
+    handoff_lat = [float(e["latency_s"]) for e in tap.events]
+    export_s = [float(e["export_s"]) for e in tap.events]
+    prefill_stats = disagg.stats()["replicas"][0]
+    decode_stats = disagg.stats()["replicas"][1]
+    row = {
+        "leg": "serving_disagg_stream",
+        "model": dict(
+            n_embd=cfg.n_embd, n_layer=cfg.n_layer,
+            vocab_size=cfg.vocab_size,
+        ),
+        "slots_per_replica": slots,
+        "max_len": max_len,
+        "page_size": page,
+        "prefill_chunk": chunk,
+        "requests": n_req,
+        "heavy_prefill_requests": len(heavy),
+        "interactive_requests": len(light),
+        "seed": seed,
+        "placement": (
+            {r: s["device_ids"] for r, s in disagg.stats()["replicas"].items()}
+            if pinned else "unpinned (needs >= 4 devices)"
+        ),
+        "roles": {
+            0: prefill_stats["role"], 1: decode_stats["role"],
+        },
+        "colocated": c_row,
+        "disagg": d_row,
+        "interactive_p99_ratio": round(ratio, 3),
+        "handoffs": {
+            "count": n_handoffs,
+            "wire_bytes_total": sum(handoff_bytes),
+            "wire_bytes_mean": (
+                round(sum(handoff_bytes) / max(1, len(handoff_bytes)))
+            ),
+            "export_ms_mean": round(
+                sum(export_s) / max(1, len(export_s)) * 1e3, 3
+            ),
+            "latency_ms_mean": round(
+                sum(handoff_lat) / max(1, len(handoff_lat)) * 1e3, 3
+            ),
+            "latency_ms_max": round(
+                max(handoff_lat, default=0.0) * 1e3, 3
+            ),
+        },
+        "outputs_match": f"{n_req - len(mismatch)}/{n_req}",
+        "observed_compile_count_steady": max(
+            max(colocated.steady_compiles().values()),
+            max(disagg.steady_compiles().values()),
+        ),
+        "invariant_failures": failures,
+        "platform": jax.devices()[0].platform,
+    }
+    if failures:
+        raise SystemExit(
+            "serving_disagg invariants violated: " + "; ".join(failures)
+        )
     return [row]
 
 
@@ -2167,6 +2473,15 @@ def main() -> int:
                          "(benchmarks/serving_spec_bench.json); "
                          "--speculative K overrides the draft depth "
                          "(default 4)")
+    ap.add_argument("--serving-disagg", action="store_true",
+                    help="benchmark DISAGGREGATED prefill/decode serving "
+                         "(dedicated prefill + decode workers, KV page "
+                         "handoff between replicas) vs a same-size "
+                         "colocated fleet on one seeded mixed stream — "
+                         "DONE-token equality, zero steady compiles and "
+                         "interactive p99 <= colocated (full run) "
+                         "ASSERTED; handoff bytes/latency reported "
+                         "(benchmarks/serving_disagg_bench.json)")
     ap.add_argument("--serving-scenarios", action="store_true",
                     help="benchmark the workload-scenario subsystem "
                          "(SLO tiers, multi-turn sessions, multi-tenant "
@@ -2216,7 +2531,8 @@ def main() -> int:
                  "--kv-quant int8 too (alone it would be silently "
                  "ignored)")
     if (args.serving or args.serving_batched or args.serving_paged
-            or args.serving_scenarios or args.serving_spec):
+            or args.serving_scenarios or args.serving_spec
+            or args.serving_disagg):
         rows = []
         if args.serving:
             rows += bench_serving(args)
@@ -2232,6 +2548,8 @@ def main() -> int:
                 rows += bench_serving_paged(args)
         if args.serving_spec:
             rows += bench_serving_spec(args)
+        if args.serving_disagg:
+            rows += bench_serving_disagg(args)
         if args.serving_scenarios:
             rows += bench_serving_scenarios(args)
         for row in rows:

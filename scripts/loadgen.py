@@ -58,7 +58,22 @@ def _pct(xs, q):
     return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
 
 
-def _fleet(args, cfg):
+def _placement(args):
+    """Per-replica device pinning: with >= ``--replicas`` devices each
+    replica gets its own device (stride-spread so a later TP variant
+    can widen each slice in place); fewer devices fall back to
+    unpinned colocation (the pre-placement behaviour) with a report
+    note instead of failing the smoke tiers."""
+    import jax
+
+    devs = jax.devices()
+    if args.placement == "pinned" and len(devs) >= args.replicas:
+        stride = len(devs) // args.replicas
+        return [devs[i * stride] for i in range(args.replicas)]
+    return None
+
+
+def _fleet(args, cfg, devices):
     from pytorch_distributed_tpu.serving.engine import (
         PagedBatchedDecodeEngine,
     )
@@ -68,6 +83,7 @@ def _fleet(args, cfg):
         return PagedBatchedDecodeEngine(
             cfg, slots=args.slots, max_len=args.max_len,
             page_size=args.page_size,
+            device=None if devices is None else devices[rep_id],
             # The storm leg must outlive transient dispatch hiccups a
             # dying neighbour can't cause but a chaos schedule might
             # compose in later; generous per-request budget, measured
@@ -75,7 +91,12 @@ def _fleet(args, cfg):
             request_retries=8, retry_backoff_s=0.0,
         )
 
-    return ReplicaRouter(make_engine, args.replicas)
+    # Parallel stepping only pays off when replicas own disjoint
+    # devices; unpinned fleets keep the deterministic sequential tick.
+    return ReplicaRouter(
+        make_engine, args.replicas,
+        parallel_step=args.parallel_step and devices is not None,
+    )
 
 
 def _drive(router, params, requests, arrivals, *, injector=None,
@@ -192,8 +213,9 @@ def run_loadgen(args) -> dict:
 
     # Two fleets for the whole sweep (one warmup each): the clean fleet
     # never faults; the storm fleet is killed and restarted per leg.
-    clean_fleet = _fleet(args, cfg)
-    storm_fleet = _fleet(args, cfg)
+    devices = _placement(args)
+    clean_fleet = _fleet(args, cfg, devices)
+    storm_fleet = _fleet(args, cfg, devices)
     clean_fleet.warmup(params)
     storm_fleet.warmup(params)
 
@@ -372,15 +394,29 @@ def run_loadgen(args) -> dict:
             "measured clock); failover re-prefills and degraded "
             "capacity until rejoin are fully in-window"
         ),
+        "placement": (
+            "unpinned (fewer devices than replicas — replicas "
+            "colocate and step sequentially; a kill shows up in "
+            "failover latency, not parallel capacity loss)"
+            if devices is None else {
+                rep_id: f"device {d.id}"
+                for rep_id, d in enumerate(devices)
+            }
+        ),
+        "parallel_step": bool(clean_fleet.parallel_step),
         "caveat": (
-            "single-process fleet: replicas step SEQUENTIALLY in one "
-            "driver thread, so aggregate tok/s is nearly "
-            "replica-count-insensitive on this rig — a kill shows up "
-            "in failover latency and the lifecycle invariants, not as "
-            "parallel capacity loss; goodput_retention ~1.0 here is "
-            "expected, and real per-replica device placement (ROADMAP "
-            "direction 1b) is where capacity-loss curves become "
-            "meaningful"
+            "replicas are pinned to disjoint devices and step on "
+            "concurrent host threads (router parallel_step), so the "
+            "storm leg's kills now cost real parallel capacity until "
+            "restart — goodput_retention < 1.0 at saturating rates is "
+            "the expected signature, where the old sequential-step "
+            "fleet read ~1.0"
+            if devices is not None else
+            "single-process unpinned fleet: replicas step SEQUENTIALLY "
+            "in one driver thread, so aggregate tok/s is nearly "
+            "replica-count-insensitive on this rig — run with enough "
+            "devices (--cpu-devices >= --replicas) for the pinned "
+            "placement curve"
         ),
         "curve": rows,
         "invariant_failures": failures,
@@ -408,6 +444,18 @@ def main() -> int:
     ap.add_argument("--first-kill-tick", type=int, default=12)
     ap.add_argument("--restart-after-ticks", type=int, default=40)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--placement", default="pinned",
+                    choices=["pinned", "none"],
+                    help="pinned (default): each replica owns its own "
+                         "device when the host has >= --replicas of "
+                         "them; none: all replicas colocate on the "
+                         "default device (the pre-placement behaviour)")
+    ap.add_argument("--parallel-step", dest="parallel_step",
+                    action="store_true", default=True,
+                    help="step pinned replicas on concurrent host "
+                         "threads (default; ignored when unpinned)")
+    ap.add_argument("--no-parallel-step", dest="parallel_step",
+                    action="store_false")
     ap.add_argument("--dryrun", action="store_true",
                     help="CI smoke: 2 replicas, tiny model, 2 rates")
     ap.add_argument("--json", default=None)
